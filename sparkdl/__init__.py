@@ -1,0 +1,10 @@
+"""Drop-in compatibility package: ``sparkdl`` is the reference's import
+name (reference ``sparkdl/__init__.py:19-24``), so existing user code
+(``from sparkdl import HorovodRunner``) works unchanged against the
+TPU-native implementation in :mod:`sparkdl_tpu`.
+"""
+
+from sparkdl_tpu import HorovodRunner
+from sparkdl_tpu.version import __version__
+
+__all__ = ["HorovodRunner"]

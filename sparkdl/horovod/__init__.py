@@ -1,0 +1,4 @@
+# Drop-in alias of sparkdl_tpu.horovod (reference sparkdl/horovod/__init__.py).
+from sparkdl_tpu.horovod import MAX_LOG_MESSAGE_LENGTH, log_to_driver
+
+__all__ = ["log_to_driver"]

@@ -1,0 +1,4 @@
+# Drop-in alias of sparkdl_tpu.horovod.runner_base.
+from sparkdl_tpu.horovod.runner_base import HorovodRunner
+
+__all__ = ["HorovodRunner"]

@@ -1,0 +1,1 @@
+# Drop-in alias of sparkdl_tpu.horovod.tensorflow.

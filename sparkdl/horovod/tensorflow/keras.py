@@ -1,0 +1,4 @@
+# Drop-in alias of sparkdl_tpu.horovod.tensorflow.keras.
+from sparkdl_tpu.horovod.tensorflow.keras import LogCallback
+
+__all__ = ["LogCallback"]

@@ -1,0 +1,17 @@
+"""sparkdl_tpu: a TPU-native framework with the capabilities of
+databricks/spark-deep-learning.
+
+Public surface parity (reference ``sparkdl/__init__.py:19-24``):
+``HorovodRunner`` is re-exported at the package root and ``__version__``
+is defined here. Unlike the reference — which only ships a local-mode
+stub and defers the distributed runtime to closed-source Databricks
+Runtime (reference ``README.md:10-11``) — this package implements the
+full distributed contract on JAX/XLA: gang launch, TPU chip binding,
+``jax.distributed`` rendezvous, XLA collectives over ICI/DCN, and a real
+worker→driver control plane.
+"""
+
+from sparkdl_tpu.horovod.runner_base import HorovodRunner
+from sparkdl_tpu.version import __version__
+
+__all__ = ["HorovodRunner"]

@@ -1,0 +1,36 @@
+"""Worker-side utilities for HorovodRunner jobs.
+
+Parity with reference ``sparkdl/horovod/__init__.py``, whose single
+public symbol ``log_to_driver`` is a ``NotImplementedError`` stub
+(reference ``sparkdl/horovod/__init__.py:20-25``). Here it is
+implemented for real on top of the control plane
+(:mod:`sparkdl_tpu.horovod.control_plane`): inside a HorovodRunner
+worker the message travels over the worker→driver TCP channel and the
+driver prints it to stdout; outside any job (e.g. local ``np=-1`` mode,
+where driver == worker) it is printed directly.
+"""
+
+MAX_LOG_MESSAGE_LENGTH = 4000  # reference sparkdl/horovod/__init__.py:23
+
+
+def log_to_driver(message):
+    """
+    Send a log message (string type) to driver side, and driver will print
+    log to stdout. If message length is greater than 4000, it will be
+    truncated. (Contract: reference ``sparkdl/horovod/__init__.py:20-25``.)
+    """
+    if not isinstance(message, str):
+        message = str(message)
+    if len(message) > MAX_LOG_MESSAGE_LENGTH:
+        message = message[:MAX_LOG_MESSAGE_LENGTH]
+    from sparkdl_tpu.horovod.control_plane import get_worker_client
+
+    client = get_worker_client()
+    if client is not None:
+        client.send_user_log(message)
+    else:
+        # Local mode: the current process IS the driver.
+        print(message, flush=True)
+
+
+__all__ = ["log_to_driver"]

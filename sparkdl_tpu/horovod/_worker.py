@@ -1,0 +1,121 @@
+"""Worker-process bootstrap for HorovodRunner gangs.
+
+Executed as ``python -m sparkdl_tpu.horovod._worker`` by the launcher.
+Reconstructs the distributed contract the reference documents but never
+implements (reference ``runner_base.py:54-61``): join the gang
+rendezvous, bind the device, deserialize the user ``main`` (cloudpickle,
+reference ``runner_base.py:82-83``), run it, and ship rank 0's return
+value back to the driver (reference ``runner_base.py:93-95``).
+
+Log routing: this process's stdout/stderr are tee'd — every line goes to
+a per-rank file in the job dir AND over the control plane to the driver,
+which merges all ranks into the job log (reference ``runner_base.py:
+62-72``).
+"""
+
+import io
+import os
+import sys
+import traceback
+
+
+class _TeeStream(io.TextIOBase):
+    """Line-buffering tee: forwards complete lines to the control plane
+    and writes through to a local per-rank log file."""
+
+    def __init__(self, stream_name, local_file, client):
+        self.stream_name = stream_name
+        self.local_file = local_file
+        self.client = client
+        self._buf = ""
+
+    def write(self, s):
+        if not isinstance(s, str):
+            s = s.decode("utf-8", "replace")
+        self.local_file.write(s)
+        self._buf += s
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            if self.client is not None:
+                self.client.send_log(self.stream_name, line)
+        return len(s)
+
+    def flush(self):
+        self.local_file.flush()
+        if self._buf:
+            if self.client is not None:
+                self.client.send_log(self.stream_name, self._buf)
+            self._buf = ""
+
+    @property
+    def closed(self):
+        return False
+
+    def writable(self):
+        return True
+
+
+def main():
+    from sparkdl_tpu.hvd import _state
+
+    rank = int(os.environ["SPARKDL_TPU_RANK"])
+    job_dir = os.environ["SPARKDL_TPU_JOB_DIR"]
+    payload_path = os.environ["SPARKDL_TPU_PAYLOAD"]
+
+    # 1. Platform selection must happen before any JAX backend init.
+    _state.ensure_jax_platform()
+
+    # 2. Control plane + log tee (before anything can print).
+    from sparkdl_tpu.horovod.control_plane import get_worker_client
+
+    client = get_worker_client()
+    local_log = open(os.path.join(job_dir, f"rank-{rank}.log"), "a", buffering=1)
+    orig_stdout, orig_stderr = sys.stdout, sys.stderr
+    sys.stdout = _TeeStream("stdout", local_log, client)
+    sys.stderr = _TeeStream("stderr", local_log, client)
+
+    exit_code = 0
+    try:
+        # 3. Gang rendezvous: jax.distributed.initialize against the
+        # launcher's coordinator (replaces MPI rendezvous, BASELINE.json).
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+
+        # 4. Tell the driver this worker is up (gang barrier on the
+        # driver side — fail-fast if any worker never arrives, reference
+        # runner_base.py:54-58).
+        if client is not None:
+            client.send_ready()
+
+        # 5. Deserialize and run the user main.
+        import cloudpickle
+
+        with open(payload_path, "rb") as f:
+            user_main, kwargs = cloudpickle.load(f)
+        result = user_main(**kwargs)
+
+        # 6. Rank 0's return value goes back to the driver.
+        if hvd.rank() == 0 and client is not None:
+            client.send_result(cloudpickle.dumps(result))
+    except BaseException:
+        exit_code = 1
+        tb = traceback.format_exc()
+        sys.stderr.write(tb + "\n")
+        if client is not None:
+            client.send_exception(tb)
+    finally:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # Interpreter shutdown flushes sys.stdout/err; the tees' backing
+        # file is about to close, so restore the originals first.
+        sys.stdout, sys.stderr = orig_stdout, orig_stderr
+        if client is not None:
+            client.send_bye(exit_code)
+            client.close()
+        local_log.close()
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""HorovodRunner: the gang launcher for distributed training functions.
+
+API parity with the reference ``sparkdl/horovod/runner_base.py:39-103``:
+the constructor is keyword-only ``(*, np, driver_log_verbosity=
+"log_callback_only")`` and ``run(main, **kwargs)`` returns ``main``'s
+return value. The reference only implements local mode (``run`` calls
+``main`` in-process, reference ``runner_base.py:97-103``) and documents
+the distributed behavior in docstrings; here every documented mode is
+implemented for real, TPU-native:
+
+- ``np == -1``  : run ``main(**kwargs)`` in the current process (exact
+  parity with the reference OSS behavior, which its tests lock in:
+  reference ``tests/horovod/runner_base_test.py:44-59``).
+- ``np <= -2``  : spawn ``-np`` subprocesses on this host (reference
+  contract ``runner_base.py:48-53``), gang-started together, each
+  ``jax.distributed.initialize``'d against a local coordinator; on TPU
+  hosts each process binds its own chip(s), on CPU each gets one
+  virtual device.
+- ``np > 0``    : launch ``np`` tasks on the cluster "starting all
+  together" with fail-fast slot checking (reference contract
+  ``runner_base.py:54-58``). One task <-> one TPU chip replaces the
+  reference's one task <-> one GPU (``runner_base.py:44-45``).
+- ``np == 0``   : deprecated "use all task slots" mode (reference
+  ``README.md:57-61``); resolves to all available slots with a warning.
+
+The worker→driver log routing policy follows the contract at reference
+``runner_base.py:62-72``: all workers' logs are merged into a single
+driver-side job log; ``driver_log_verbosity="all"`` additionally streams
+every line to the driver's stdout, while the default
+``"log_callback_only"`` surfaces only messages sent through
+``sparkdl_tpu.horovod.log_to_driver`` (and callbacks built on it).
+The return value of rank 0's ``main`` is shipped back to the driver via
+cloudpickle (reference contract ``runner_base.py:93-95``).
+"""
+
+import logging
+
+_LOG_VERBOSITY_VALUES = ("all", "log_callback_only")
+
+
+class HorovodRunner:
+    """HorovodRunner runs distributed deep learning training jobs.
+
+    The open-source reference runs the training function locally and
+    defers distributed launching to Databricks Runtime (reference
+    ``runner_base.py:32-37``); this implementation launches real gangs
+    of TPU-bound worker processes using ``jax.distributed`` for
+    rendezvous and XLA collectives over ICI/DCN for communication.
+    """
+
+    def __init__(self, *, np, driver_log_verbosity="log_callback_only"):
+        """
+        :param np: number of parallel processes to use for the Horovod job.
+            This argument only takes effect on Databricks Runtime in the
+            reference; here it is honored everywhere:
+
+            - If np >= 0, launch a gang of np cluster tasks, each bound
+              to one TPU chip (one task slot <-> one chip, replacing the
+              reference's GPU binding, reference ``runner_base.py:44-45``).
+              The tasks start all together; if np is greater than the
+              total number of task slots, the job fails fast (reference
+              ``runner_base.py:54-58``). np = 0 (use all
+
+              slots) is deprecated (reference ``README.md:57-61``).
+            - If np < 0, spawn ``-np`` subprocesses on the driver node
+              (reference ``runner_base.py:48-53``). np = -1 runs
+              ``main`` in the current process, which is the mode the
+              reference's own unit tests lock in (reference
+              ``tests/horovod/runner_base_test.py:44-59``).
+
+        :param driver_log_verbosity: driver log verbosity, "all" or
+            "log_callback_only" (default). "all" streams every worker's
+            logs to the driver in real time (may be noisy during
+            training, reference ``runner_base.py:65-68``); the default
+            surfaces only logs sent via
+            :func:`sparkdl_tpu.horovod.log_to_driver` and callbacks
+            built on it (reference ``runner_base.py:68-72``). In both
+            modes the full merged worker logs are written to a job log
+            file (reference ``runner_base.py:62-64``).
+        """
+        if not isinstance(np, int) or isinstance(np, bool):
+            raise TypeError(
+                f"HorovodRunner np must be an int, got {type(np).__name__}: {np!r}"
+            )
+        if driver_log_verbosity not in _LOG_VERBOSITY_VALUES:
+            raise ValueError(
+                "driver_log_verbosity must be one of "
+                f"{_LOG_VERBOSITY_VALUES}, got {driver_log_verbosity!r}"
+            )
+        self.num_processor = np
+        self.driver_log_verbosity = driver_log_verbosity
+
+    def run(self, main, **kwargs):
+        """Runs a Horovod training job invoking main(**kwargs).
+
+        The main function and the keyword arguments are serialized using
+        cloudpickle and distributed to the gang's workers (reference
+        contract ``runner_base.py:82-83``); pickling a large closure
+        makes the job slow to start (reference ``runner_base.py:90-91``),
+        so change global state inside ``main`` rather than capturing
+        large objects.
+
+        :return: return value of rank 0's ``main`` (shipped back to the
+            driver with cloudpickle, reference ``runner_base.py:93-95``);
+            in-process for np = -1 (reference ``runner_base.py:103``).
+        """
+        np_arg = self.num_processor
+        logger = logging.getLogger("HorovodRunner")
+        if np_arg == -1:
+            logger.warning(
+                "HorovodRunner is running in local mode (np=-1): main() is "
+                "invoked in the current process with a single worker. Use "
+                "np<=-2 for a local multi-process gang or np>0 for a "
+                "cluster gang."
+            )
+            from sparkdl_tpu.hvd import _state as hvd_state
+
+            with hvd_state.local_mode():
+                return main(**kwargs)
+        # All other modes launch a real gang of worker processes.
+        from sparkdl_tpu.horovod.launcher import launch_gang
+
+        return launch_gang(
+            np=np_arg,
+            main=main,
+            kwargs=kwargs,
+            driver_log_verbosity=self.driver_log_verbosity,
+        )

@@ -1,0 +1,3 @@
+# TensorFlow integration namespace for HorovodRunner jobs.
+# Parity with reference sparkdl/horovod/tensorflow/__init__.py (an empty
+# namespace package).

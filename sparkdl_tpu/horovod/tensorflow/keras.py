@@ -1,0 +1,59 @@
+"""Keras LogCallback: streams training progress to the driver notebook.
+
+Real implementation of the reference stub
+``sparkdl/horovod/tensorflow/keras.py:16-34`` (all of whose methods
+raise NotImplementedError): a ``keras.callbacks.Callback`` whose
+epoch/batch hooks format compact progress lines and ship them over the
+worker→driver channel (:func:`sparkdl_tpu.horovod.log_to_driver`), which
+is the only log path that surfaces under the default
+``driver_log_verbosity="log_callback_only"`` policy (reference
+``runner_base.py:68-72``).
+"""
+
+import time
+
+from tensorflow import keras
+
+from sparkdl_tpu.horovod import log_to_driver
+
+__all__ = ["LogCallback"]
+
+
+def _fmt_logs(logs):
+    if not logs:
+        return ""
+    return " - ".join(
+        f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+        for k, v in logs.items()
+    )
+
+
+class LogCallback(keras.callbacks.Callback):
+    """
+    A simple HorovodRunner log callback that streams event logs to
+    notebook cell output. (Contract: reference ``keras.py:16-25``.)
+    """
+
+    def __init__(self, per_batch_log=False):
+        """
+        :param per_batch_log: whether to output logs per batch, default: False.
+        """
+        super().__init__()
+        self.per_batch_log = per_batch_log
+        self._epoch_start = None
+        self._epoch = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._epoch_start = time.time()
+        log_to_driver(f"Epoch {epoch} begin at {time.strftime('%Y-%m-%d %H:%M:%S')}")
+
+    def on_batch_end(self, batch, logs=None):
+        if self.per_batch_log:
+            msg = _fmt_logs(logs)
+            log_to_driver(f"Epoch {self._epoch} batch {batch}: {msg}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        dt = time.time() - (self._epoch_start or time.time())
+        msg = _fmt_logs(logs)
+        log_to_driver(f"Epoch {epoch} end ({dt:.1f}s): {msg}")

@@ -1,0 +1,128 @@
+"""Process-wide Horovod-shim state.
+
+Horovod's model is one rank per process (reference contract: np tasks,
+one process per task slot, ``runner_base.py:44-45``). The launcher
+(:mod:`sparkdl_tpu.horovod.launcher`) exports rank/size/local_rank and
+the ``jax.distributed`` coordinator address via environment variables;
+``init()`` here resolves them. In local mode (``np=-1``,
+reference ``runner_base.py:103``) the runner enters
+:func:`local_mode`, which pins size=1 without any rendezvous.
+"""
+
+import contextlib
+import os
+import threading
+
+COORD_ENV = "SPARKDL_TPU_COORDINATOR"
+RANK_ENV = "SPARKDL_TPU_RANK"
+SIZE_ENV = "SPARKDL_TPU_SIZE"
+LOCAL_RANK_ENV = "SPARKDL_TPU_LOCAL_RANK"
+LOCAL_SIZE_ENV = "SPARKDL_TPU_LOCAL_SIZE"
+FORCE_PLATFORM_ENV = "SPARKDL_TPU_FORCE_PLATFORM"
+
+
+class _HvdState:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.jax_distributed = False
+
+
+_state = _HvdState()
+
+
+def state():
+    return _state
+
+
+def ensure_jax_platform():
+    """Apply the forced platform before any backend initialization.
+
+    Needed because the environment may pin ``jax_platforms`` via config
+    (not env), e.g. test rigs that run gangs on CPU devices.
+    """
+    import jax
+
+    forced = os.environ.get(FORCE_PLATFORM_ENV)
+    if forced:
+        jax.config.update("jax_platforms", forced)
+        if forced == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def init():
+    """Initialize the shim: resolve rank/size and, in a multi-process
+    gang, ensure ``jax.distributed`` is initialized against the
+    launcher's coordinator (the TPU-native replacement for Horovod's
+    MPI rendezvous, per the north star in BASELINE.json)."""
+    import jax
+
+    with _state.lock:
+        if _state.initialized:
+            return
+        size = int(os.environ.get(SIZE_ENV, "1"))
+        rank = int(os.environ.get(RANK_ENV, "0"))
+        _state.local_rank = int(os.environ.get(LOCAL_RANK_ENV, str(rank)))
+        _state.local_size = int(os.environ.get(LOCAL_SIZE_ENV, str(size)))
+        coord = os.environ.get(COORD_ENV)
+        if size > 1 and coord:
+            ensure_jax_platform()
+            if not _state.jax_distributed:
+                from jax._src import distributed as _jd
+
+                if _jd.global_state.client is None:
+                    jax.distributed.initialize(
+                        coordinator_address=coord,
+                        num_processes=size,
+                        process_id=rank,
+                    )
+                _state.jax_distributed = True
+            rank = jax.process_index()
+            size = jax.process_count()
+        _state.rank = rank
+        _state.size = size
+        _state.initialized = True
+
+
+def shutdown():
+    with _state.lock:
+        _state.initialized = False
+        _state.rank = 0
+        _state.size = 1
+        _state.local_rank = 0
+        _state.local_size = 1
+
+
+def require_initialized():
+    if not _state.initialized:
+        raise ValueError(
+            "Horovod has not been initialized; call hvd.init() first."
+        )
+
+
+@contextlib.contextmanager
+def local_mode():
+    """Single-process mode used by HorovodRunner(np=-1): hvd.init()
+    inside the user's main resolves to rank 0 of 1 without rendezvous
+    (parity with the reference's in-process local run,
+    ``runner_base.py:97-103``)."""
+    with _state.lock:
+        prev = (
+            _state.initialized, _state.rank, _state.size,
+            _state.local_rank, _state.local_size,
+        )
+        _state.initialized = False
+        _state.rank = 0
+        _state.size = 1
+        _state.local_rank = 0
+        _state.local_size = 1
+    try:
+        yield
+    finally:
+        with _state.lock:
+            (_state.initialized, _state.rank, _state.size,
+             _state.local_rank, _state.local_size) = prev

@@ -1,0 +1,67 @@
+"""Framework tensor interop: numpy <-> jax / torch / tensorflow.
+
+The Horovod shim accepts tensors from any of the frameworks a user
+``main`` might use (tf.keras, PyTorch, JAX — the north-star requirement
+that existing training functions run unmodified, BASELINE.json) and
+routes them through JAX collectives. Conversions go through numpy;
+framework libraries are only touched if the user already imported them
+(``sys.modules`` check), so importing sparkdl_tpu never drags in tf or
+torch.
+"""
+
+import sys
+
+import numpy as np
+
+
+def _torch():
+    return sys.modules.get("torch")
+
+
+def _tf():
+    return sys.modules.get("tensorflow")
+
+
+def is_torch_tensor(x):
+    t = _torch()
+    return t is not None and isinstance(x, t.Tensor)
+
+
+def is_tf_tensor(x):
+    tf = _tf()
+    return tf is not None and isinstance(x, (tf.Tensor, tf.Variable))
+
+
+def to_numpy(x):
+    """Convert a framework tensor (or scalar) to a numpy array."""
+    if isinstance(x, np.ndarray):
+        return x
+    if is_torch_tensor(x):
+        return x.detach().cpu().numpy()
+    if is_tf_tensor(x):
+        return x.numpy()
+    # jax.Array and python scalars both take this path; np.asarray on a
+    # jax.Array device-transfers without copy when already on host.
+    return np.asarray(x)
+
+
+def from_numpy_like(result, template):
+    """Convert numpy ``result`` back to the framework/type of ``template``."""
+    if isinstance(template, np.ndarray):
+        return result
+    if is_torch_tensor(template):
+        t = _torch()
+        out = t.from_numpy(np.ascontiguousarray(result))
+        return out.to(device=template.device, dtype=template.dtype)
+    if is_tf_tensor(template):
+        tf = _tf()
+        return tf.convert_to_tensor(result, dtype=template.dtype)
+    if "jax" in sys.modules:
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(template, jax.Array):
+            return jnp.asarray(result)
+    if np.isscalar(template) or isinstance(template, (int, float)):
+        return result.item() if np.ndim(result) == 0 else result
+    return result

@@ -1,0 +1,6 @@
+# Version of the sparkdl-tpu framework.
+#
+# The reference (databricks/spark-deep-learning) keeps its version in
+# sparkdl/__init__.py:24 as '2.2.0-db1'. We keep ours in a dedicated
+# module so setup.py can read it without importing heavy dependencies.
+__version__ = "0.1.0"

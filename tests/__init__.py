@@ -1,0 +1,2 @@
+# Smoke import, mirroring reference tests/__init__.py:15.
+import sparkdl_tpu  # noqa: F401

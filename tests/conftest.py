@@ -1,0 +1,27 @@
+"""Test rig configuration.
+
+Tests run on CPU with a virtual 8-device host platform (the TPU-native
+test strategy from SURVEY.md §4: single-process multi-device via
+``--xla_force_host_platform_device_count``, true multi-process gangs via
+subprocess + jax.distributed with gloo collectives). Must run before any
+test initializes a JAX backend; the axon sitecustomize pins
+``jax_platforms`` via config, so the env var alone is not enough — we
+update the config explicitly.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Workers spawned by the gang launcher must also run on CPU.
+os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+# Keep gang sizes honest on small CI machines.
+os.environ.setdefault("SPARKDL_TPU_START_TIMEOUT", "180")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,85 @@
+"""Unit tests for the worker→driver control plane."""
+
+import os
+
+import pytest
+
+from sparkdl_tpu.horovod.control_plane import (
+    ControlPlaneClient,
+    ControlPlaneServer,
+)
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = ControlPlaneServer(
+        num_workers=2, verbosity="log_callback_only",
+        log_path=str(tmp_path / "job.log"),
+    )
+    yield srv
+    srv.close()
+
+
+def _drain(server):
+    import time
+
+    time.sleep(0.2)
+
+
+def test_ready_barrier_and_result(server):
+    c0 = ControlPlaneClient(server.address, rank=0)
+    c1 = ControlPlaneClient(server.address, rank=1)
+    c0.send_ready()
+    assert not server.wait_ready(0.2)  # only 1/2 ready → fail-fast path
+    c1.send_ready()
+    assert server.wait_ready(5)
+    c0.send_result(b"pickled-bytes")
+    _drain(server)
+    assert server.result_bytes == b"pickled-bytes"
+    c0.close()
+    c1.close()
+
+
+def test_log_routing_default_suppresses_worker_logs(server, capfd, tmp_path):
+    c = ControlPlaneClient(server.address, rank=0)
+    c.send_log("stdout", "noisy training output")
+    c.send_user_log("selected message")
+    _drain(server)
+    out = capfd.readouterr().out
+    assert "selected message" in out
+    assert "noisy training output" not in out
+    # ...but everything is merged into the job log (runner_base.py:62-64)
+    log = (tmp_path / "job.log").read_text()
+    assert "noisy training output" in log
+    assert "selected message" in log
+    c.close()
+
+
+def test_log_routing_all_streams_everything(tmp_path, capfd):
+    srv = ControlPlaneServer(
+        num_workers=1, verbosity="all", log_path=str(tmp_path / "job.log")
+    )
+    try:
+        c = ControlPlaneClient(srv.address, rank=3)
+        c.send_log("stderr", "worker chatter")
+        _drain(srv)
+        assert "worker chatter" in capfd.readouterr().out
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_exception_collection(server):
+    c = ControlPlaneClient(server.address, rank=1)
+    c.send_exception("Traceback: boom")
+    c.send_bye(1)
+    _drain(server)
+    assert server.exceptions == {1: "Traceback: boom"}
+    c.close()
+
+
+def test_worker_client_singleton_absent_outside_jobs():
+    from sparkdl_tpu.horovod import control_plane
+
+    assert os.environ.get(control_plane.CONTROL_ADDR_ENV) is None
+    assert control_plane.get_worker_client() is None
