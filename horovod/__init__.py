@@ -1,0 +1,45 @@
+"""Drop-in ``horovod`` package, TPU-native.
+
+The reference's entire design launches user mains that ``import
+horovod.* as hvd`` (reference ``runner_base.py:32-37``; north star in
+BASELINE.json: "existing tf.keras and PyTorch training functions run
+unmodified on TPU"). This package provides that import surface, backed
+by :mod:`sparkdl_tpu.hvd` — collectives ride ``jax.lax.psum`` over the
+pod's ICI mesh instead of Horovod's MPI/NCCL ring.
+
+Submodules mirror Horovod's layout: ``horovod.tensorflow``,
+``horovod.tensorflow.keras``, ``horovod.keras``, ``horovod.torch``.
+"""
+
+from sparkdl_tpu.hvd import (  # noqa: F401
+    Average,
+    Compression,
+    Max,
+    Min,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_object,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    gloo_built,
+    grouped_allreduce,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    reducescatter,
+    rocm_built,
+    shutdown,
+    size,
+)
+from sparkdl_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,4 @@
+# ``horovod.keras`` is an alias of ``horovod.tensorflow.keras`` (as in
+# upstream Horovod, where it wraps the standalone keras package).
+from horovod.tensorflow.keras import *  # noqa: F401,F403
+from horovod.tensorflow.keras import callbacks  # noqa: F401

@@ -1,0 +1,130 @@
+"""``horovod.tensorflow`` shim: TF tensors in, XLA collectives under.
+
+Eager tensors convert via numpy; symbolic tensors (inside a
+``tf.function``, which is where ``model.fit`` puts the train step) are
+routed through ``tf.py_function`` so the JAX collective executes at
+graph runtime. This is the correctness-first bridge for the hard part
+ranked #1 in SURVEY.md §7 (TF↔JAX device coexistence); the zero-copy
+dlpack fast path is tracked on the roadmap.
+"""
+
+import tensorflow as tf
+
+from sparkdl_tpu.hvd import (  # noqa: F401
+    Average,
+    Compression,
+    Max,
+    Min,
+    Sum,
+    allgather,
+    alltoall,
+    barrier,
+    broadcast_object,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    gloo_built,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    rocm_built,
+    shutdown,
+    size,
+)
+from sparkdl_tpu.hvd import _resolve_op, _state
+from sparkdl_tpu.hvd._collectives import engine
+
+
+def _numpy_collective(x_tf, fn):
+    """Run a numpy-level collective on a TF tensor, eagerly or from
+    inside a tf.function via py_function."""
+    if tf.executing_eagerly() or isinstance(x_tf, tf.__internal__.EagerTensor):
+        out = fn(x_tf.numpy())
+        return tf.convert_to_tensor(out)
+
+    def _py(t):
+        return tf.convert_to_tensor(fn(t.numpy()))
+
+    out = tf.py_function(_py, [x_tf], x_tf.dtype)
+    out.set_shape(x_tf.shape)
+    return out
+
+
+def _densify(tensor):
+    if isinstance(tensor, tf.IndexedSlices):
+        return tf.convert_to_tensor(tensor)
+    return tensor
+
+
+def allreduce(tensor, average=None, name=None, op=None, **kwargs):
+    del name, kwargs
+    _state.require_initialized()
+    tensor = _densify(tf.convert_to_tensor(tensor))
+    kind = _resolve_op(average, op)
+    return _numpy_collective(tensor, lambda x: engine().reduce(x, kind))
+
+
+def broadcast(tensor, root_rank, name=None):
+    del name
+    _state.require_initialized()
+    tensor = tf.convert_to_tensor(tensor)
+    return _numpy_collective(
+        tensor, lambda x: engine().broadcast(x, root_rank)
+    )
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every variable its root-rank value — the determinism
+    check the reference contract requires before training starts
+    (``hvd.broadcast_variables`` in the BASELINE.json north star;
+    SURVEY.md §5.2 race-detection analogue)."""
+    _state.require_initialized()
+    variables = list(variables)
+    if size() == 1 or not variables:
+        return
+    # One fused broadcast: ship all values as a single pickled object
+    # from root (control-plane-free, rides the same XLA collectives).
+    values = [v.numpy() for v in variables]
+    synced = broadcast_object(values, root_rank=root_rank)
+    for var, val in zip(variables, synced):
+        var.assign(val)
+
+
+class DistributedGradientTape:
+    """Wraps tf.GradientTape so .gradient() returns allreduced grads
+    (horovod.tensorflow.DistributedGradientTape parity)."""
+
+    def __init__(self, tape, compression=None, op=None, average=None):
+        self._tape = tape
+        self._op = _resolve_op(average, op)
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return [
+            None if g is None else allreduce(g, op=self._op) for g in grads
+        ]
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "allreduce", "allgather",
+    "broadcast", "broadcast_object", "broadcast_variables", "barrier",
+    "alltoall", "Average", "Sum", "Min", "Max", "Compression",
+    "DistributedGradientTape",
+]

@@ -1,0 +1,67 @@
+"""``horovod.tensorflow.keras`` shim: DistributedOptimizer + callbacks
+for tf.keras (Keras 3) training loops, allreduce on XLA collectives.
+
+This is the module a reference user's ``main`` imports (the README's
+canonical example trains tf.keras under HorovodRunner, reference
+``README.md:33-54``); with it, that main runs unmodified on TPU.
+"""
+
+import tensorflow as tf
+
+from horovod.tensorflow import (  # noqa: F401
+    Average,
+    Compression,
+    Max,
+    Min,
+    Sum,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    broadcast_object,
+    broadcast_variables,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod.tensorflow import _resolve_op
+from horovod.tensorflow.keras import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None, compression=None,
+                         op=None, average=None, **kwargs):
+    """Wrap a keras optimizer so apply_gradients allreduces gradients
+    across the gang first (Horovod DistributedOptimizer semantics:
+    average by default, so the effective batch is np × per-worker
+    batch)."""
+    del name, compression, kwargs
+    kind = _resolve_op(average, op)
+    cls = optimizer.__class__
+
+    class _DistributedOptimizer(cls):
+        _hvd_op = kind
+
+        def apply_gradients(self, grads_and_vars, **kw):
+            gv = list(grads_and_vars)
+            reduced = [
+                (None if g is None else allreduce(g, op=self._hvd_op), v)
+                for g, v in gv
+            ]
+            return super().apply_gradients(reduced, **kw)
+
+    _DistributedOptimizer.__name__ = "Distributed" + cls.__name__
+    optimizer.__class__ = _DistributedOptimizer
+    return optimizer
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "allreduce", "allgather", "broadcast",
+    "broadcast_object", "broadcast_variables", "barrier",
+    "DistributedOptimizer", "callbacks", "Average", "Sum", "Min", "Max",
+    "Compression",
+]

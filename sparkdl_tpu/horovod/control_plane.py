@@ -43,9 +43,10 @@ CONTROL_ADDR_ENV = "SPARKDL_TPU_CONTROL_ADDR"
 RANK_ENV = "SPARKDL_TPU_RANK"
 
 # Guard against a runaway worker flooding the driver (backpressure
-# contract, reference runner_base.py:65-68): frames larger than this are
-# truncated by the sender.
-MAX_FRAME_PAYLOAD = 1 << 20
+# contract, reference runner_base.py:65-68): log text is truncated by
+# the sender BEFORE JSON-encoding (truncating the encoded frame would
+# produce invalid JSON and poison the connection).
+MAX_LOG_TEXT = 64 << 10
 
 
 def _recv_exact(sock, n):
@@ -70,7 +71,8 @@ class ControlPlaneServer:
     ``log_to_driver``) are printed.
     """
 
-    def __init__(self, num_workers, verbosity="log_callback_only", log_path=None):
+    def __init__(self, num_workers, verbosity="log_callback_only", log_path=None,
+                 bind_host="127.0.0.1", advertise_host=None):
         self.num_workers = num_workers
         self.verbosity = verbosity
         self.log_path = log_path
@@ -85,9 +87,19 @@ class ControlPlaneServer:
         self._ready_cond = threading.Condition(self._lock)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("127.0.0.1", 0))
+        self._srv.bind((bind_host, 0))
         self._srv.listen(max(num_workers, 8))
-        self.address = "%s:%d" % self._srv.getsockname()
+        port = self._srv.getsockname()[1]
+        if advertise_host is None:
+            # When bound to all interfaces (cluster mode), advertise a
+            # routable address — loopback would point remote workers at
+            # themselves.
+            advertise_host = (
+                socket.gethostbyname(socket.gethostname())
+                if bind_host == "0.0.0.0"
+                else bind_host
+            )
+        self.address = f"{advertise_host}:{port}"
         self._closed = False
         self._threads = []
         self._accept_thread = threading.Thread(
@@ -120,7 +132,20 @@ class ControlPlaneServer:
                 payload = _recv_exact(conn, length - 5)
                 if payload is None:
                     return
-                self._handle(mtype, rank, payload)
+                try:
+                    self._handle(mtype, rank, payload)
+                except Exception:
+                    # A malformed frame must not kill the connection —
+                    # READY/RESULT/BYE from this rank still need to
+                    # arrive. Log and keep serving.
+                    import traceback
+
+                    with self._lock:
+                        if self._log_file is not None:
+                            self._log_file.write(
+                                f"[control-plane] bad frame from rank {rank}:\n"
+                                f"{traceback.format_exc()}\n"
+                            )
         except OSError:
             pass
         finally:
@@ -183,6 +208,14 @@ class ControlPlaneServer:
                 self._ready_cond.wait(remaining)
         return True
 
+    def ready_count(self):
+        with self._lock:
+            return len(self._ready)
+
+    def done_count(self):
+        with self._lock:
+            return len(self._done)
+
     @property
     def exceptions(self):
         with self._lock:
@@ -216,8 +249,6 @@ class ControlPlaneClient:
         self._lock = threading.Lock()
 
     def _send(self, mtype, payload):
-        if len(payload) > MAX_FRAME_PAYLOAD and mtype != MSG_RESULT:
-            payload = payload[:MAX_FRAME_PAYLOAD]
         frame = _HEADER.pack(len(payload) + 5, mtype, self.rank) + payload
         with self._lock:
             try:
@@ -232,10 +263,10 @@ class ControlPlaneClient:
         self._send(MSG_READY, b"")
 
     def send_log(self, stream, text):
-        self._send_json(MSG_LOG, {"stream": stream, "text": text})
+        self._send_json(MSG_LOG, {"stream": stream, "text": text[:MAX_LOG_TEXT]})
 
     def send_user_log(self, text):
-        self._send_json(MSG_USERLOG, {"text": text})
+        self._send_json(MSG_USERLOG, {"text": text[:MAX_LOG_TEXT]})
 
     def send_result(self, pickled_bytes):
         self._send(MSG_RESULT, pickled_bytes)

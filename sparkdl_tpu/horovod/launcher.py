@@ -196,6 +196,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity):
         num_workers, mode, job_dir,
     )
     procs = []
+    boot_logs = []
     try:
         for r in range(num_workers):
             env = _worker_env(
@@ -204,38 +205,92 @@ def launch_gang(np, main, kwargs, driver_log_verbosity):
                 payload_path=payload_path, job_dir=job_dir,
                 platform=platform,
             )
+            # Boot-phase output (before the worker installs its log tee
+            # — e.g. import errors) lands in the same per-rank log file
+            # via an O_APPEND handle, so nothing is ever lost.
+            boot_log = open(
+                os.path.join(job_dir, f"rank-{r}.log"), "ab", buffering=0
+            )
+            boot_logs.append(boot_log)
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "sparkdl_tpu.horovod._worker"],
                 env=env,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
+                stdout=boot_log,
+                stderr=subprocess.STDOUT,
             ))
 
-        timeout = float(os.environ.get(START_TIMEOUT_ENV, DEFAULT_START_TIMEOUT))
-        if not server.wait_ready(timeout):
-            raise RuntimeError(
-                f"HorovodRunner gang failed to start: only "
-                f"{len(server._ready)}/{num_workers} workers reached the "
-                f"rendezvous within {timeout:.0f}s (fail-fast, reference "
-                f"runner_base.py:54-58). Worker logs: {job_dir}"
-            )
-
-        # Wait for all workers to exit.
-        exit_codes = [p.wait() for p in procs]
-        if any(exit_codes):
+        def _fail(reason, exit_codes=None):
             excs = server.exceptions
             detail = "\n".join(
                 f"--- rank {r} ---\n{tb}" for r, tb in sorted(excs.items())
             )
             if not detail:
-                bad = [r for r, c in enumerate(exit_codes) if c]
+                bad = (
+                    [r for r, c in enumerate(exit_codes) if c]
+                    if exit_codes is not None
+                    else range(num_workers)
+                )
                 detail = "\n".join(
-                    f"--- rank {r} (exit {exit_codes[r]}) log tail ---\n"
+                    f"--- rank {r} log tail ---\n"
                     + _tail(os.path.join(job_dir, f"rank-{r}.log"))
                     for r in bad
                 )
-            raise RuntimeError(
-                f"HorovodRunner job failed (exit codes {exit_codes}).\n{detail}"
+            raise RuntimeError(f"{reason}\n{detail}")
+
+        # Gang rendezvous with fail-fast (reference runner_base.py:54-58):
+        # abort immediately if any worker dies before READY, not after
+        # the full start timeout.
+        timeout = float(os.environ.get(START_TIMEOUT_ENV, DEFAULT_START_TIMEOUT))
+        deadline = time.monotonic() + timeout
+        while server.ready_count() < num_workers:
+            dead = [
+                (r, p.poll()) for r, p in enumerate(procs)
+                if p.poll() is not None and p.poll() != 0
+            ]
+            if dead:
+                time.sleep(0.5)  # let EXC frames drain
+                _fail(
+                    "HorovodRunner gang failed to start: worker(s) "
+                    f"{[r for r, _ in dead]} exited during rendezvous "
+                    f"(codes {[c for _, c in dead]}). Worker logs: {job_dir}"
+                )
+            if time.monotonic() > deadline:
+                _fail(
+                    f"HorovodRunner gang failed to start: only "
+                    f"{server.ready_count()}/{num_workers} workers reached "
+                    f"the rendezvous within {timeout:.0f}s (fail-fast, "
+                    f"reference runner_base.py:54-58). Worker logs: {job_dir}"
+                )
+            time.sleep(0.05)
+
+        # Monitor the running gang. If one rank dies while others are
+        # blocked in a collective (which has no timeout on ICI), give the
+        # survivors a grace period, then kill them — a failed gang must
+        # not wedge the pod (SURVEY.md §7 hard part #3).
+        grace = float(os.environ.get("SPARKDL_TPU_ABORT_GRACE", "30"))
+        first_death = None
+        while any(p.poll() is None for p in procs):
+            codes = [p.poll() for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                if first_death is None:
+                    first_death = time.monotonic()
+                elif time.monotonic() - first_death > grace:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    _fail(
+                        "HorovodRunner job failed: worker(s) "
+                        f"{[r for r, c in enumerate(codes) if c not in (None, 0)]} "
+                        f"died; surviving ranks were killed after a "
+                        f"{grace:.0f}s grace period to avoid a wedged "
+                        f"collective.", [c or 0 for c in codes],
+                    )
+            time.sleep(0.1)
+        exit_codes = [p.wait() for p in procs]
+        if any(exit_codes):
+            _fail(
+                f"HorovodRunner job failed (exit codes {exit_codes}).",
+                exit_codes,
             )
 
         result_bytes = None
@@ -254,4 +309,9 @@ def launch_gang(np, main, kwargs, driver_log_verbosity):
         for p in procs:
             if p.poll() is None:
                 p.kill()  # a failed gang must not wedge the pod
+        for f in boot_logs:
+            try:
+                f.close()
+            except OSError:
+                pass
         server.close()

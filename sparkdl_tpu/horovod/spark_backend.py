@@ -30,16 +30,30 @@ def _barrier_main(payload_bytes, verbosity, control_addr):
 
     def run_partition(_):
         import os
+        import socket
+
         import cloudpickle
 
         ctx = BarrierTaskContext.get()
         rank = ctx.partitionId()
         infos = ctx.getTaskInfos()
         size = len(infos)
-        coord_host = infos[0].address.split(":")[0]
+        # Coordinator election: rank 0 binds a free port on its own host
+        # and the address is gossiped to the gang via the barrier's
+        # allGather — no hardcoded ports, no loopback assumptions.
+        if rank == 0:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            probe.bind(("", 0))
+            port = probe.getsockname()[1]
+            probe.close()
+            my_host = socket.gethostbyname(socket.gethostname())
+            coord = f"{my_host}:{port}"
+        else:
+            coord = ""
+        coords = ctx.allGather(coord)
         os.environ["SPARKDL_TPU_RANK"] = str(rank)
         os.environ["SPARKDL_TPU_SIZE"] = str(size)
-        os.environ["SPARKDL_TPU_COORDINATOR"] = f"{coord_host}:9479"
+        os.environ["SPARKDL_TPU_COORDINATOR"] = coords[0]
         if control_addr:
             os.environ["SPARKDL_TPU_CONTROL_ADDR"] = control_addr
         ctx.barrier()  # gang start: all together (runner_base.py:54-55)
@@ -75,7 +89,12 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
             f"HorovodRunner requested np={num_workers} but the cluster has "
             f"only {total_slots} task slots; failing fast."
         )
-    server = ControlPlaneServer(num_workers, verbosity=driver_log_verbosity)
+    # Bind on all interfaces and advertise a routable driver address —
+    # executors on other hosts must be able to reach log_to_driver's
+    # channel (reference sparkdl/horovod/__init__.py:20-25).
+    server = ControlPlaneServer(
+        num_workers, verbosity=driver_log_verbosity, bind_host="0.0.0.0"
+    )
     try:
         payload = cloudpickle.dumps((main, kwargs))
         rdd = sc.parallelize(range(num_workers), num_workers).barrier()

@@ -161,6 +161,11 @@ def alltoall(tensor, splits=None, name=None):
             )
         splits = [x.shape[0] // n] * n
     splits = [int(s) for s in np.asarray(to_numpy(splits)).tolist()]
+    if len(splits) != n or sum(splits) != x.shape[0]:
+        raise ValueError(
+            f"alltoall splits {splits} must have one entry per rank ({n}) "
+            f"and sum to the tensor's dim0 ({x.shape[0]})"
+        )
     if n == 1:
         return from_numpy_like(x.copy(), tensor)
     # Exchange split tables, gather everything, then pick my slices.

@@ -82,3 +82,32 @@ def test_np_positive_cluster_mode_local_slots(monkeypatch):
     result = HorovodRunner(np=2).run(_allreduce_main, scale=2.0)
     assert result["size"] == 2
     assert result["sum"] == [6.0, 6.0, 6.0]
+
+
+@pytest.mark.gang
+def test_fast_fail_when_worker_dies_during_rendezvous(monkeypatch):
+    """A worker crashing before READY must abort the gang promptly (not
+    after the full start timeout) and surface its traceback."""
+    import time
+
+    monkeypatch.setenv("SPARKDL_TPU_WORKER_PLATFORM", "bogus-platform")
+    monkeypatch.setenv("SPARKDL_TPU_START_TIMEOUT", "300")
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="rendezvous"):
+        HorovodRunner(np=-2).run(lambda: None)
+    assert time.monotonic() - t0 < 120  # fail-fast, not timeout-bound
+
+
+@pytest.mark.gang
+def test_oversized_log_line_does_not_poison_control_plane(capfd):
+    """A >64KB stdout line is truncated sender-side; READY/RESULT still
+    flow (regression: mid-JSON truncation used to kill the channel)."""
+
+    def noisy_main():
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        print("A" * 200_000)
+        return hvd.size()
+
+    assert HorovodRunner(np=-2, driver_log_verbosity="all").run(noisy_main) == 2
