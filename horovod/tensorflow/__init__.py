@@ -88,9 +88,12 @@ def broadcast_variables(variables, root_rank=0):
     variables = list(variables)
     if size() == 1 or not variables:
         return
-    # One fused broadcast: ship all values as a single pickled object
-    # from root (control-plane-free, rides the same XLA collectives).
-    values = [v.numpy() for v in variables]
+    # One fused broadcast: root ships all values as a single pickled
+    # object (rides the same XLA collectives). Non-root ranks don't
+    # materialize host copies — broadcast_object discards their payload.
+    values = (
+        [v.numpy() for v in variables] if rank() == root_rank else None
+    )
     synced = broadcast_object(values, root_rank=root_rank)
     for var, val in zip(variables, synced):
         var.assign(val)
@@ -116,9 +119,12 @@ class DistributedGradientTape:
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
-        return [
-            None if g is None else allreduce(g, op=self._op) for g in grads
-        ]
+        # sources may be a single tensor, a list, or any nested
+        # structure — mirror its shape, like tf.GradientTape does.
+        return tf.nest.map_structure(
+            lambda g: None if g is None else allreduce(g, op=self._op),
+            grads,
+        )
 
 
 __all__ = [

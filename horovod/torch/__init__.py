@@ -79,7 +79,12 @@ def broadcast_parameters(params, root_rank=0):
     else:
         items = sorted(dict(params).items())
     tensors = [t for _, t in items if isinstance(t, torch.Tensor)]
-    values = [t.detach().cpu().numpy() for t in tensors]
+    # Only root materializes host copies; broadcast_object ignores the
+    # payload on other ranks.
+    values = (
+        [t.detach().cpu().numpy() for t in tensors]
+        if rank() == root_rank else None
+    )
     synced = broadcast_object(values, root_rank=root_rank)
     with torch.no_grad():
         for t, v in zip(tensors, synced):
@@ -144,6 +149,13 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     class _DistributedOptimizer(cls):
         def step(self, closure=None):
             _state.require_initialized()
+            # With a closure, evaluate it FIRST (it recomputes local
+            # gradients), then reduce, then apply — reducing before
+            # super().step(closure) would let the closure's backward()
+            # overwrite the reduced grads with local ones.
+            loss = None
+            if closure is not None:
+                loss = closure()
             if _state.state().size > 1 and not getattr(
                 self, "_hvd_skip_sync", False
             ):
@@ -151,7 +163,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                     p for g in self.param_groups for p in g["params"]
                 ]
                 _fused_allreduce_grads(params, self._hvd_op)
-            return super().step(closure)
+            out = super().step()
+            return loss if closure is not None else out
 
         def synchronize(self):
             params = [p for g in self.param_groups for p in g["params"]]

@@ -1,0 +1,14 @@
+# Drop-in alias of sparkdl_tpu.xgboost (reference sparkdl/xgboost/__init__.py).
+from sparkdl_tpu.xgboost import (
+    XgboostClassifier,
+    XgboostClassifierModel,
+    XgboostRegressor,
+    XgboostRegressorModel,
+)
+
+__all__ = [
+    "XgboostClassifier",
+    "XgboostClassifierModel",
+    "XgboostRegressor",
+    "XgboostRegressorModel",
+]

@@ -49,6 +49,21 @@ RANK_ENV = "SPARKDL_TPU_RANK"
 MAX_LOG_TEXT = 64 << 10
 
 
+def routable_host_ip():
+    """Best-effort routable IP of this host (UDP-connect trick —
+    ``gethostbyname(gethostname())`` resolves to 127.0.1.1 on stock
+    Debian-style /etc/hosts, which would point remote workers at their
+    own loopback)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))  # no packets sent
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
 def _recv_exact(sock, n):
     buf = b""
     while len(buf) < n:
@@ -95,9 +110,7 @@ class ControlPlaneServer:
             # routable address — loopback would point remote workers at
             # themselves.
             advertise_host = (
-                socket.gethostbyname(socket.gethostname())
-                if bind_host == "0.0.0.0"
-                else bind_host
+                routable_host_ip() if bind_host == "0.0.0.0" else bind_host
             )
         self.address = f"{advertise_host}:{port}"
         self._closed = False
@@ -272,6 +285,9 @@ class ControlPlaneClient:
         self._send(MSG_RESULT, pickled_bytes)
 
     def send_exception(self, tb_text):
+        # Tracebacks can embed huge reprs; keep the tail (the raise site).
+        if len(tb_text) > 4 * MAX_LOG_TEXT:
+            tb_text = "...[truncated]...\n" + tb_text[-4 * MAX_LOG_TEXT:]
         self._send_json(MSG_EXC, {"traceback": tb_text})
 
     def send_bye(self, exit_code):

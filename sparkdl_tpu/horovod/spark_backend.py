@@ -42,12 +42,13 @@ def _barrier_main(payload_bytes, verbosity, control_addr):
         # and the address is gossiped to the gang via the barrier's
         # allGather — no hardcoded ports, no loopback assumptions.
         if rank == 0:
+            from sparkdl_tpu.horovod.control_plane import routable_host_ip
+
             probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             probe.bind(("", 0))
             port = probe.getsockname()[1]
             probe.close()
-            my_host = socket.gethostbyname(socket.gethostname())
-            coord = f"{my_host}:{port}"
+            coord = f"{routable_host_ip()}:{port}"
         else:
             coord = ""
         coords = ctx.allGather(coord)

@@ -1,0 +1,16 @@
+"""Gradient-boosted-tree estimators (reference
+``sparkdl/xgboost/__init__.py:19-23`` public surface)."""
+
+from sparkdl_tpu.xgboost.xgboost import (
+    XgboostClassifier,
+    XgboostClassifierModel,
+    XgboostRegressor,
+    XgboostRegressorModel,
+)
+
+__all__ = [
+    "XgboostClassifier",
+    "XgboostClassifierModel",
+    "XgboostRegressor",
+    "XgboostRegressorModel",
+]

@@ -1,0 +1,190 @@
+"""Estimator-surface tests, modeled on the reference's doctest examples
+(reference ``xgboost.py:221-240``, ``:309-326``) and its param-contract
+clauses, running on pandas DataFrames (pyspark optional)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sparkdl.xgboost import (
+    XgboostClassifier,
+    XgboostClassifierModel,
+    XgboostRegressor,
+    XgboostRegressorModel,
+)
+
+
+def _reg_frame(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3).astype(np.float32)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    return pd.DataFrame({
+        "features": list(X),
+        "label": y.astype(np.float32),
+        "isVal": (np.arange(n) % 5 == 0),
+        "weight": np.ones(n, np.float32),
+    })
+
+
+def _clf_frame(n=400, n_classes=2, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    if n_classes == 2:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    else:
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float32)
+    return pd.DataFrame({"features": list(X), "label": y})
+
+
+def test_regressor_fit_transform_reference_example_shape():
+    """The reference doctest flow: constructor kwargs incl. renamed
+    params, fit, transform adds predictionCol."""
+    df = _reg_frame()
+    reg = XgboostRegressor(
+        max_depth=5, missing=0.0, validationIndicatorCol="isVal",
+        weightCol="weight", early_stopping_rounds=3, eval_metric="rmse",
+        n_estimators=50,
+    )
+    model = reg.fit(df)
+    assert isinstance(model, XgboostRegressorModel)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+    rmse = float(np.sqrt(np.mean((out["prediction"] - df["label"]) ** 2)))
+    assert rmse < 0.5
+
+
+def test_classifier_binary_columns_and_margins():
+    df = _clf_frame()
+    clf = XgboostClassifier(n_estimators=30, max_depth=4)
+    model = clf.fit(df)
+    assert isinstance(model, XgboostClassifierModel)
+    out = model.transform(df)
+    # rawPrediction always carries margins (output_margin replacement,
+    # reference xgboost.py:274-276); probability + prediction present.
+    assert {"rawPrediction", "probability", "prediction"} <= set(out.columns)
+    acc = float((out["prediction"] == df["label"]).mean())
+    assert acc > 0.95
+    proba = np.stack(out["probability"].to_numpy())
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    raw = np.stack(out["rawPrediction"].to_numpy())
+    assert raw.shape == (len(df), 2)
+
+
+def test_classifier_multiclass():
+    df = _clf_frame(n_classes=3)
+    model = XgboostClassifier(n_estimators=20, max_depth=4).fit(df)
+    out = model.transform(df)
+    assert float((out["prediction"] == df["label"]).mean()) > 0.9
+    assert np.stack(out["probability"].to_numpy()).shape[1] == 3
+
+
+def test_blocked_params_raise_with_replacement_hint():
+    """Renamed-param contract (reference xgboost.py:258-285)."""
+    with pytest.raises(ValueError, match="use_gpu"):
+        XgboostClassifier(gpu_id=0)
+    with pytest.raises(ValueError, match="baseMarginCol"):
+        XgboostRegressor(base_margin=1.0)
+    with pytest.raises(ValueError, match="weightCol"):
+        XgboostRegressor(sample_weight=[1.0])
+    with pytest.raises(ValueError, match="validationIndicatorCol"):
+        XgboostClassifier(eval_set=[])
+    with pytest.raises(ValueError, match="rawPredictionCol"):
+        XgboostClassifier(output_margin=True)
+    with pytest.raises(ValueError, match="Unknown param"):
+        XgboostRegressor(definitely_not_a_param=3)
+
+
+def test_param_surface_discoverable():
+    """Params are discoverable as `Param(parent=...` entries (reference
+    xgboost.py:304-305) and carry the special-handling params."""
+    clf = XgboostClassifier()
+    names = {p.name for p in clf.params}
+    assert {"missing", "callbacks", "num_workers", "use_gpu",
+            "force_repartition", "use_external_storage",
+            "external_storage_precision", "baseMarginCol", "featuresCol",
+            "labelCol", "weightCol", "predictionCol", "probabilityCol",
+            "rawPredictionCol", "validationIndicatorCol", "n_estimators",
+            "max_depth", "learning_rate"} <= names
+    assert "missing" in clf.explainParams()
+
+
+def test_missing_zero_semantics():
+    """missing=0.0 treats zeros as absent (reference xgboost.py:41-47)."""
+    df = _reg_frame()
+    model = XgboostRegressor(missing=0.0, n_estimators=10).fit(df)
+    out = model.transform(df)
+    assert np.isfinite(out["prediction"]).all()
+
+
+def test_callbacks_invoked_each_round():
+    rounds = []
+    df = _reg_frame(n=100)
+    XgboostRegressor(
+        n_estimators=7, callbacks=[lambda rnd, margins: rounds.append(rnd)]
+    ).fit(df)
+    assert rounds == list(range(7))
+
+
+def test_estimator_and_model_persistence(tmp_path):
+    """MLWritable/MLReadable surface (reference xgboost.py:117-141)."""
+    df = _reg_frame()
+    reg = XgboostRegressor(n_estimators=15, max_depth=3, learning_rate=0.2)
+    est_path = str(tmp_path / "estimator")
+    reg.save(est_path)
+    reg2 = XgboostRegressor.load(est_path)
+    assert reg2.getOrDefault(reg2.n_estimators) == 15
+    assert reg2.getOrDefault(reg2.learning_rate) == 0.2
+
+    model = reg.fit(df)
+    model_path = str(tmp_path / "model")
+    model.write().save(model_path)
+    model2 = XgboostRegressorModel.read().load(model_path)
+    p1 = model.transform(df)["prediction"].to_numpy()
+    p2 = model2.transform(df)["prediction"].to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    assert model2.get_booster() is not None
+
+
+def test_external_storage_mode():
+    df = _reg_frame()
+    model = XgboostRegressor(
+        use_external_storage=True, external_storage_precision=3,
+        n_estimators=10,
+    ).fit(df)
+    out = model.transform(df)
+    assert np.isfinite(out["prediction"]).all()
+    with pytest.raises(ValueError, match="external_storage"):
+        XgboostRegressor(
+            use_external_storage=True, weightCol="weight", n_estimators=2
+        ).fit(df)
+
+
+def test_warm_start_via_xgb_model():
+    df = _reg_frame()
+    m1 = XgboostRegressor(n_estimators=10).fit(df)
+    m2 = XgboostRegressor(n_estimators=5, xgb_model=m1.get_booster()).fit(df)
+    assert len(m2.get_booster().trees) == 15
+
+
+@pytest.mark.gang
+def test_distributed_num_workers_gang(monkeypatch):
+    """num_workers=2: one booster worker per slot, histograms allreduced
+    over the gang (Rabit → ICI contract, reference xgboost.py:58-64)."""
+    monkeypatch.setenv("SPARKDL_TPU_NUM_SLOTS", "2")
+    df = _clf_frame(n=600)
+    clf = XgboostClassifier(
+        n_estimators=20, max_depth=4, num_workers=2, force_repartition=True
+    )
+    model = clf.fit(df)
+    out = model.transform(df)
+    assert float((out["prediction"] == df["label"]).mean()) > 0.9
+
+
+@pytest.mark.gang
+def test_distributed_base_margin_rejected():
+    df = _reg_frame()
+    df["margin"] = 0.0
+    with pytest.raises(ValueError, match="distributed"):
+        XgboostRegressor(
+            num_workers=2, baseMarginCol="margin", n_estimators=2
+        ).fit(df)
