@@ -1,0 +1,98 @@
+"""Flagship benchmark: Llama-decoder LoRA training throughput on one
+chip (tokens/sec/chip — the per-chip scale-out unit behind
+BASELINE.json's samples/sec/chip metric; the reference publishes no
+numbers, see BASELINE.md, so vs_baseline is reported against this
+framework's own round-1 value once recorded).
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.models import Llama, LlamaConfig, lora_mask
+    from sparkdl_tpu.parallel.train import (
+        cross_entropy_loss,
+        make_train_step,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16, lora_rank=16,
+    )
+    batch, seq = 8, 1024
+    model = Llama(cfg)
+    tokens = np.zeros((batch, seq), np.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    mask = lora_mask(params)
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["inputs"])
+        return cross_entropy_loss(logits, b["targets"])
+
+    step = make_train_step(loss_fn, opt, param_mask=mask)
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "inputs": jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                              jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                               jnp.int32),
+    }
+
+    n_steps = 20
+
+    # The whole measured loop lives inside ONE jitted program
+    # (lax.scan over steps): per-dispatch RPC overhead through remote
+    # device tunnels would otherwise dominate, and block_until_ready
+    # alone does not guarantee completion there — only a host readback
+    # does. (Same pattern as MaxText-style benchmarking.)
+    @jax.jit
+    def run_n(params, opt_state, b):
+        def body(carry, _):
+            p, s = carry
+            p, s, m = step(p, s, b)
+            return (p, s), m["loss"]
+
+        (p, s), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=n_steps
+        )
+        return p, s, losses[-1]
+
+    # compile + warm
+    p_w, s_w, last = run_n(params, opt_state, batch_data)
+    _ = np.asarray(last)
+    del p_w, s_w
+
+    t0 = time.perf_counter()
+    _, _, last = run_n(params, opt_state, batch_data)
+    last_loss = float(np.asarray(last))  # host readback = true sync
+    dt = time.perf_counter() - t0
+    assert np.isfinite(last_loss)
+
+    tokens_per_sec = n_steps * batch * seq / dt
+    print(json.dumps({
+        "metric": "llama_lora_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    # Keep stdout pure JSON: route stray warnings to stderr.
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    sys.stderr.write("bench: llama-lora single-chip train throughput\n")
+    main()

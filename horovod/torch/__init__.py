@@ -147,15 +147,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     cls = optimizer.__class__
 
     class _DistributedOptimizer(cls):
-        def step(self, closure=None):
-            _state.require_initialized()
-            # With a closure, evaluate it FIRST (it recomputes local
-            # gradients), then reduce, then apply — reducing before
-            # super().step(closure) would let the closure's backward()
-            # overwrite the reduced grads with local ones.
-            loss = None
-            if closure is not None:
-                loss = closure()
+        def _hvd_sync(self):
             if _state.state().size > 1 and not getattr(
                 self, "_hvd_skip_sync", False
             ):
@@ -163,8 +155,24 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                     p for g in self.param_groups for p in g["params"]
                 ]
                 _fused_allreduce_grads(params, self._hvd_op)
-            out = super().step()
-            return loss if closure is not None else out
+
+        def step(self, closure=None):
+            _state.require_initialized()
+            if closure is None:
+                self._hvd_sync()
+                return super().step()
+            # Closure path: wrap it so EVERY evaluation (LBFGS calls it
+            # repeatedly) recomputes local grads and then reduces —
+            # reducing before super().step(closure) would let the
+            # closure's backward() overwrite reduced grads with local
+            # ones.
+            def synced_closure():
+                with torch.enable_grad():
+                    loss = closure()
+                self._hvd_sync()
+                return loss
+
+            return super().step(synced_closure)
 
         def synchronize(self):
             params = [p for g in self.param_groups for p in g["params"]]

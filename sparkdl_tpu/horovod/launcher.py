@@ -146,13 +146,25 @@ def _tail(path, n=40):
         return ""
 
 
-def launch_gang(np, main, kwargs, driver_log_verbosity):
-    """Launch a gang of workers and return rank 0's result."""
+def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
+    """Launch a gang of workers and return rank 0's result.
+
+    :param per_rank_kwargs: optional list (len = gang size) of dicts
+        merged into ``kwargs`` for each rank and serialized into that
+        rank's own payload — so rank-private data (e.g. a dataset
+        shard) is shipped only to its worker instead of to the whole
+        gang.
+    """
     import cloudpickle
 
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
 
     num_workers, mode = _resolve_num_workers(np)
+    if per_rank_kwargs is not None and len(per_rank_kwargs) != num_workers:
+        raise ValueError(
+            f"per_rank_kwargs has {len(per_rank_kwargs)} entries for a "
+            f"gang of {num_workers}"
+        )
 
     # Spark barrier-mode backend when a real Spark cluster is attached
     # (reference runner_base.py:54-61: "the 2nd spark job started by
@@ -170,18 +182,28 @@ def launch_gang(np, main, kwargs, driver_log_verbosity):
             pass
 
     job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-job-")
-    payload_path = os.path.join(job_dir, "payload.pkl")
-    payload = cloudpickle.dumps((main, kwargs))
-    if len(payload) > LARGE_PAYLOAD_BYTES:
-        # Contract: pickling a large main slows job start (reference
-        # runner_base.py:90-91).
-        logger.warning(
-            "Pickled main + kwargs is %.1f MB; large closures make "
-            "HorovodRunner jobs slow to start. Move data loading inside "
-            "main().", len(payload) / 2**20,
-        )
-    with open(payload_path, "wb") as f:
-        f.write(payload)
+    payload_paths = []
+    for r in range(num_workers):
+        rank_kwargs = dict(kwargs)
+        if per_rank_kwargs is not None:
+            rank_kwargs.update(per_rank_kwargs[r])
+        payload = cloudpickle.dumps((main, rank_kwargs))
+        if r == 0 and len(payload) > LARGE_PAYLOAD_BYTES:
+            # Contract: pickling a large main slows job start (reference
+            # runner_base.py:90-91).
+            logger.warning(
+                "Pickled main + kwargs is %.1f MB; large closures make "
+                "HorovodRunner jobs slow to start. Move data loading "
+                "inside main().", len(payload) / 2**20,
+            )
+        path = os.path.join(job_dir, f"payload-{r}.pkl")
+        with open(path, "wb") as f:
+            f.write(payload)
+        payload_paths.append(path)
+        if per_rank_kwargs is None:
+            # identical payload for everyone: write once, share
+            payload_paths = [path] * num_workers
+            break
 
     server = ControlPlaneServer(
         num_workers,
@@ -202,7 +224,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity):
             env = _worker_env(
                 os.environ, rank=r, size=num_workers,
                 coordinator=coordinator, control_addr=server.address,
-                payload_path=payload_path, job_dir=job_dir,
+                payload_path=payload_paths[r], job_dir=job_dir,
                 platform=platform,
             )
             # Boot-phase output (before the worker installs its log tee

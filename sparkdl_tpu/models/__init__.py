@@ -1,0 +1,9 @@
+"""Model zoo covering the reference's benchmark families
+(BASELINE.json configs): MNIST CNN, ResNet, BERT, and the Llama
+decoder with LoRA — all flax, all written for bf16 MXU math and GSPMD
+sharding via :mod:`sparkdl_tpu.parallel.sharding`.
+"""
+
+from sparkdl_tpu.models.llama import Llama, LlamaConfig  # noqa: F401
+from sparkdl_tpu.models.lora import lora_mask  # noqa: F401
+from sparkdl_tpu.models.mnist_cnn import MnistCNN  # noqa: F401

@@ -1,0 +1,179 @@
+"""Llama-family decoder (the flagship for the Llama-3-8B LoRA
+north-star config in BASELINE.json), written TPU-first:
+
+- bf16 activations/params with fp32 softmax and norms (MXU-native).
+- module names chosen to match
+  :data:`sparkdl_tpu.parallel.sharding.TRANSFORMER_RULES` so GSPMD
+  tensor parallelism is a pure annotation change.
+- attention is injectable: dense reference attention on one chip,
+  :func:`sparkdl_tpu.parallel.ring_attention.ring_self_attention` when
+  the sequence axis is sharded.
+- static shapes everywhere; RoPE precomputed; GQA via head repetition.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.lora import LoRADense
+from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Sequence[str] = ("q_proj", "v_proj")
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        return cls(vocab_size=128256, d_model=4096, n_layers=32,
+                   n_heads=32, n_kv_heads=8, d_ff=14336, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """CI-size config (full architecture, small dims)."""
+        defaults = dict(vocab_size=256, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _dense(cfg, features, name):
+    if cfg.lora_rank and name in cfg.lora_targets:
+        return LoRADense(features=features, rank=cfg.lora_rank,
+                         alpha=cfg.lora_alpha, dtype=cfg.dtype, name=name)
+    return nn.Dense(features=features, use_bias=False, dtype=cfg.dtype,
+                    name=name)
+
+
+def rope_freqs(head_dim, max_seq, theta):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)                       # (S, D/2)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin, positions):
+    # x: (B, S, H, D); positions: (S,) or (B, S)
+    c = cos[positions][..., None, :]              # (.., S, 1, D/2)
+    s = sin[positions][..., None, :]
+    if c.ndim == 3:                               # positions was (S,)
+        c, s = c[None], s[None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.n_heads
+        b, s, _ = x.shape
+        q = _dense(cfg, cfg.n_heads * head_dim, "q_proj")(x)
+        k = _dense(cfg, cfg.n_kv_heads * head_dim, "k_proj")(x)
+        v = _dense(cfg, cfg.n_kv_heads * head_dim, "v_proj")(x)
+        q = q.reshape(b, s, cfg.n_heads, head_dim)
+        k = k.reshape(b, s, cfg.n_kv_heads, head_dim)
+        v = v.reshape(b, s, cfg.n_kv_heads, head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        # GQA: repeat kv heads up to n_heads
+        rep = cfg.n_heads // cfg.n_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attend = self.attention_fn or (
+            lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=True)
+        )
+        o = attend(q, k, v).reshape(b, s, cfg.n_heads * head_dim)
+        return _dense(cfg, cfg.d_model, "o_proj")(o)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _dense(cfg, cfg.d_ff, "gate_proj")(x)
+        up = _dense(cfg, cfg.d_ff, "up_proj")(x)
+        h = nn.silu(gate) * up
+        return _dense(cfg, cfg.d_model, "down_proj")(h)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions):
+        cfg = self.cfg
+        h = x + Attention(cfg, self.attention_fn, name="attn")(
+            RMSNorm(cfg.rms_eps, name="attn_norm")(x), cos, sin, positions
+        )
+        return h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_eps, name="mlp_norm")(h)
+        )
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.arange(s)
+        head_dim = cfg.d_model // cfg.n_heads
+        # Static RoPE table sized to the (static) sequence length;
+        # callers passing explicit positions must keep them < max(s, 2048).
+        cos, sin = rope_freqs(head_dim, max(s, 2048), cfg.rope_theta)
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="embed")(tokens)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.attention_fn, name=f"layer_{i}")(
+                x, cos, sin, positions
+            )
+        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=jnp.float32, name="lm_head")(
+            x.astype(jnp.float32)
+        )
+        return logits

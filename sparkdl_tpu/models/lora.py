@@ -1,0 +1,73 @@
+"""LoRA: low-rank adapters for parameter-efficient fine-tuning (the
+Llama-3-8B LoRA north-star config, BASELINE.json).
+
+TPU framing: the frozen base matmul stays a full-width bf16 MXU op; the
+adapter path is two skinny matmuls XLA fuses into the same HBM pass.
+Only ``lora_a``/``lora_b`` receive gradients — enforce with
+:func:`lora_mask` + the ``param_mask`` option of
+:func:`sparkdl_tpu.parallel.train.make_train_step` (or optax.masked).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LoRADense(nn.Module):
+    """Dense layer with a low-rank residual adapter:
+    ``y = x @ W + (alpha/rank) * (x @ A) @ B``."""
+
+    features: int
+    rank: int = 8
+    alpha: float = 16.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (d_in, self.features)
+        ).astype(self.dtype)
+        lora_a = self.param(
+            "lora_a", nn.initializers.normal(stddev=0.02),
+            (d_in, self.rank),
+        ).astype(self.dtype)
+        lora_b = self.param(
+            "lora_b", nn.initializers.zeros, (self.rank, self.features)
+        ).astype(self.dtype)
+        base = x @ kernel
+        delta = (x @ lora_a) @ lora_b
+        return base + (self.alpha / self.rank) * delta
+
+
+def lora_mask(params, extra_trainable=()):
+    """Bool pytree: True only for lora_a/lora_b leaves (plus any param
+    whose path contains one of ``extra_trainable``)."""
+
+    def mask_leaf(path, _):
+        keys = [str(getattr(p, "key", "")) for p in path]
+        if any(k in ("lora_a", "lora_b") for k in keys):
+            return True
+        return any(any(t in k for k in keys) for t in extra_trainable)
+
+    return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+
+def merge_lora_with(params, alpha, rank):
+    """Fold adapters into base kernels for deployment:
+    ``kernel += (alpha/rank)·A@B``, adapters zeroed. The (alpha, rank)
+    used in training must be passed explicitly."""
+    def merge(node):
+        if isinstance(node, dict) and "lora_a" in node and "kernel" in node:
+            node = dict(node)
+            node["kernel"] = node["kernel"] + (alpha / rank) * (
+                node["lora_a"] @ node["lora_b"]
+            )
+            node["lora_a"] = jnp.zeros_like(node["lora_a"])
+            node["lora_b"] = jnp.zeros_like(node["lora_b"])
+            return node
+        if isinstance(node, dict):
+            return {k: merge(v) for k, v in node.items()}
+        return node
+
+    return merge(jax.tree.map(lambda x: x, params))

@@ -1,0 +1,16 @@
+"""Multi-chip parallelism: mesh construction, sharding rules, pjit
+train steps, sequence-parallel ring attention, and pipeline stages.
+
+The reference supports only Horovod-style data parallelism (SURVEY.md
+§2.3: "DP — the only one"); this package is the TPU-native superset the
+build plan calls for — a ``('data','fsdp','seq','model')`` mesh where
+DP is one axis among several, so the same runner scales JAX mains from
+MNIST to the Llama-LoRA north-star config (BASELINE.json) without
+changing the launcher.
+"""
+
+from sparkdl_tpu.parallel.mesh import MeshSpec, best_mesh, make_mesh  # noqa: F401
+from sparkdl_tpu.parallel.sharding import (  # noqa: F401
+    constrain,
+    param_sharding,
+)

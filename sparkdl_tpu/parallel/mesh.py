@@ -1,0 +1,88 @@
+"""Device-mesh construction.
+
+Axes (any may be 1 and is then effectively absent):
+
+- ``data``  — pure data parallelism (the reference's only axis).
+- ``fsdp``  — data parallelism with parameter sharding (ZeRO-3 style;
+  XLA inserts all-gathers/reduce-scatters from the shardings).
+- ``seq``   — sequence/context parallelism (ring attention).
+- ``model`` — tensor parallelism (Megatron-style column/row splits).
+
+Collectives ride ICI within a slice; `jax.experimental.mesh_utils`
+orders devices so neighboring mesh coordinates are ICI neighbors.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+
+AXES = ("data", "fsdp", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    data: int = 1
+    fsdp: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def size(self):
+        return self.data * self.fsdp * self.seq * self.model
+
+    def axis_sizes(self):
+        return (self.data, self.fsdp, self.seq, self.model)
+
+
+def make_mesh(spec=None, devices=None):
+    """Build a Mesh over ``devices`` (default: all) shaped by ``spec``
+    (default: all devices on the ``data`` axis — reference-parity DP)."""
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if spec is None:
+        spec = MeshSpec(data=n)
+    if spec.size != n:
+        raise ValueError(
+            f"MeshSpec {spec} needs {spec.size} devices, got {n}"
+        )
+    if devices == jax.devices() and n > 1:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                spec.axis_sizes(), devices=devices
+            )
+        except (ValueError, AssertionError):
+            dev_array = np.array(devices).reshape(spec.axis_sizes())
+    else:
+        dev_array = np.array(devices).reshape(spec.axis_sizes())
+    return Mesh(dev_array, AXES)
+
+
+def best_mesh(n_devices, *, model_parallel=1, seq_parallel=1, fsdp=False):
+    """Heuristic spec: give `model`/`seq` what was asked, put the rest
+    on `data` (or `fsdp`)."""
+    rest = n_devices // (model_parallel * seq_parallel)
+    if rest * model_parallel * seq_parallel != n_devices:
+        raise ValueError(
+            f"{n_devices} devices not divisible by model_parallel="
+            f"{model_parallel} * seq_parallel={seq_parallel}"
+        )
+    if fsdp:
+        return MeshSpec(data=1, fsdp=rest, seq=seq_parallel,
+                        model=model_parallel)
+    return MeshSpec(data=rest, fsdp=1, seq=seq_parallel,
+                    model=model_parallel)
+
+
+def log2_factors(n):
+    """(a, b) with a*b == n, as square as possible (both powers of 2
+    when n is)."""
+    a = 2 ** (int(math.log2(n)) // 2) if n > 1 else 1
+    while n % a:
+        a //= 2
+    return a, n // a

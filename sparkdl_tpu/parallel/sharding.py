@@ -1,0 +1,79 @@
+"""Sharding rules: map parameter pytrees and activations onto the mesh.
+
+Rules are name-based regex → PartitionSpec, applied over the flattened
+param tree (flax params are nested dicts; the joined path is matched).
+This is the GSPMD recipe: annotate shardings, let XLA insert the
+collectives (scaling-book methodology referenced by the build brief).
+"""
+
+import re
+
+import numpy as np
+
+
+def constrain(x, *spec):
+    """``with_sharding_constraint`` sugar usable inside pjit."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def _match(rules, path):
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return None
+
+
+def param_sharding(params, rules, mesh):
+    """PartitionSpec pytree for ``params``: first matching rule wins;
+    unmatched params are replicated. Specs whose sharded dims don't
+    divide the param's shape fall back to replication (safe default for
+    tiny test configs)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def spec_for(path, leaf):
+        spec = _match(rules, path)
+        if spec is None:
+            return P()
+        spec = P(*spec) if not isinstance(spec, P) else spec
+        # validate divisibility
+        for dim, names in enumerate(spec):
+            if names is None:
+                continue
+            names = names if isinstance(names, tuple) else (names,)
+            total = int(np.prod([axis_sizes[a] for a in names]))
+            if dim >= leaf.ndim or leaf.shape[dim] % total:
+                return P()
+        return spec
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+    flat_specs = []
+    for path, leaf in leaves_with_path:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        flat_specs.append(spec_for(key, leaf))
+    tree = jax.tree_util.tree_unflatten(treedef, flat_specs)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), tree,
+                        is_leaf=lambda s: isinstance(s, P))
+
+
+# Megatron-style rules for the transformer models in
+# sparkdl_tpu.models: column-parallel up-projections, row-parallel
+# down-projections, replicated norms.
+TRANSFORMER_RULES = [
+    (r"embed.*embedding", (None, "model")),
+    (r"(q_proj|k_proj|v_proj|qkv).*kernel", (("fsdp",), "model")),
+    (r"o_proj.*kernel", ("model", ("fsdp",))),
+    (r"(gate_proj|up_proj|fc1).*kernel", (("fsdp",), "model")),
+    (r"(down_proj|fc2).*kernel", ("model", ("fsdp",))),
+    (r"lm_head.*kernel", (("fsdp",), "model")),
+    (r"lora_a.*kernel", (None, None)),
+    (r"lora_b.*kernel", (None, "model")),
+    (r"(norm|ln|layernorm).*", ()),
+]

@@ -1,0 +1,126 @@
+"""pjit train-step factory: the path a JAX ``main`` uses under
+HorovodRunner (SURVEY.md §7 step 7 — mesh ('data','model') so the
+Llama-LoRA north-star config launches through the same runner).
+
+The step is GSPMD-sharded end to end: params carry NamedShardings from
+:func:`sparkdl_tpu.parallel.sharding.param_sharding`, the batch is
+sharded on ``data`` (and optionally ``seq``), gradients reduce over the
+data axes automatically because XLA derives the collectives from the
+shardings — no explicit psum, no hand-scheduled overlap.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_train_step(loss_fn, optimizer, *, grad_accum=1, remat=False,
+                    param_mask=None):
+    """Build ``step(params, opt_state, batch, *extra) -> (params,
+    opt_state, metrics)``.
+
+    :param loss_fn: ``f(params, batch, *extra) -> scalar loss`` (or
+        ``(loss, aux)`` — detected via has_aux if it returns a tuple).
+    :param optimizer: an optax GradientTransformation.
+    :param grad_accum: microbatch count; the batch's leading axis is
+        split and gradients averaged via ``lax.scan`` (HBM-friendly:
+        activations live one microbatch at a time).
+    :param remat: wrap loss_fn in ``jax.checkpoint`` — trade FLOPs for
+        HBM on long sequences.
+    :param param_mask: optional pytree of bools; False leaves get zero
+        gradients (LoRA-style partial training).
+    """
+    f = jax.checkpoint(loss_fn) if remat else loss_fn
+    grad_fn = jax.value_and_grad(f)
+
+    def apply_mask(grads):
+        if param_mask is None:
+            return grads
+        return jax.tree.map(
+            lambda g, m: g if m else jnp.zeros_like(g), grads, param_mask
+        )
+
+    def single(params, opt_state, batch, *extra):
+        loss, grads = grad_fn(params, batch, *extra)
+        grads = apply_mask(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, {"loss": loss}
+
+    if grad_accum == 1:
+        return single
+
+    def accumulated(params, opt_state, batch, *extra):
+        micro = jax.tree.map(
+            lambda x: x.reshape((grad_accum, x.shape[0] // grad_accum)
+                                + x.shape[1:]),
+            batch,
+        )
+
+        def acc_step(carry, mb):
+            g_acc, l_acc = carry
+            loss, grads = grad_fn(params, mb, *extra)
+            g_acc = jax.tree.map(jnp.add, g_acc, grads)
+            return (g_acc, l_acc + loss), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (g_sum, l_sum), _ = jax.lax.scan(acc_step, (zeros, 0.0), micro)
+        grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
+        grads = apply_mask(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, {"loss": l_sum / grad_accum}
+
+    return accumulated
+
+
+def shard_batch(batch, mesh, *, seq_axis=False):
+    """Device-put a host batch with (data[, seq]) sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        if x.ndim >= 2 and seq_axis:
+            spec = P(("data", "fsdp"), "seq")
+        else:
+            spec = P(("data", "fsdp"))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)
+
+
+def replicate(tree, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def cross_entropy_loss(logits, labels, *, ignore_index=None):
+    """Token-level softmax cross entropy, fp32 accumulation."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if ignore_index is not None:
+        mask = labels != ignore_index
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+def global_batch(rng, vocab, batch, seq):
+    """Synthetic LM batch (benchmarks and dryruns)."""
+    tokens = np.asarray(
+        rng.integers(0, vocab, size=(batch, seq + 1)), np.int32
+    )
+    return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+def param_count(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, tree
+    )

@@ -258,6 +258,22 @@ _DEFAULT_METRIC = {
     "multi:softprob": "mlogloss",
 }
 
+# Compiled predict kernels, shared across Boosters and transform()
+# calls (keyed by the static config; jax caches per input shape).
+_PREDICT_FNS = {}
+
+
+def _predict_fn(max_depth, n_bins):
+    import jax
+
+    key = (max_depth, n_bins)
+    fn = _PREDICT_FNS.get(key)
+    if fn is None:
+        fn = jax.jit(partial(_predict_stage, max_depth=max_depth,
+                             n_bins=n_bins))
+        _PREDICT_FNS[key] = fn
+    return fn
+
 
 # ---------------------------------------------------------------------------
 # Booster
@@ -273,14 +289,18 @@ class Booster:
     """
 
     def __init__(self, params, edges, missing, trees, base_score,
-                 n_classes, best_iteration=None):
+                 n_classes, best_iteration=None, n_base_trees=0):
         self.params = dict(params)
         self.edges = edges
         self.missing = missing
         self.trees = trees  # list of dicts of np arrays, len = rounds*K
         self.base_score = base_score
         self.n_classes = n_classes
+        # best_iteration counts boosting rounds of the LAST train()
+        # call; n_base_trees is how many trees predate it (warm start),
+        # which best-iteration truncation must keep.
         self.best_iteration = best_iteration
+        self.n_base_trees = n_base_trees
 
     # -- persistence --------------------------------------------------------
 
@@ -298,6 +318,7 @@ class Booster:
             "n_classes": self.n_classes,
             "n_trees": len(self.trees),
             "best_iteration": self.best_iteration,
+            "n_base_trees": self.n_base_trees,
         }
 
         def _np_safe(o):
@@ -329,13 +350,12 @@ class Booster:
         if isinstance(base, list):
             base = np.asarray(base, np.float32)
         return cls(meta["params"], data["edges"], missing, trees, base,
-                   meta["n_classes"], meta.get("best_iteration"))
+                   meta["n_classes"], meta.get("best_iteration"),
+                   meta.get("n_base_trees", 0))
 
     # -- inference ----------------------------------------------------------
 
     def predict_margin(self, X, iteration_range=None):
-        import jax
-
         X = np.asarray(X, np.float32)
         binned = bin_data(X, self.edges, self.missing)
         max_depth = int(self.params["max_depth"])
@@ -344,10 +364,11 @@ class Booster:
         margins = np.zeros((X.shape[0], k), np.float32) + self.base_score
         trees = self.trees
         if iteration_range is None and self.best_iteration is not None:
-            trees = trees[: (self.best_iteration + 1) * k]
+            # keep warm-start trees + the best rounds of the last fit
+            trees = trees[: self.n_base_trees + (self.best_iteration + 1) * k]
         elif iteration_range is not None:
             trees = trees[iteration_range[0] * k : iteration_range[1] * k]
-        fn = jax.jit(partial(_predict_stage, max_depth=max_depth, n_bins=n_bins))
+        fn = _predict_fn(max_depth, n_bins)
         for i, t in enumerate(trees):
             margins[:, i % k] += np.asarray(fn(
                 binned, t["feat"], t["thr"], t["missing_left"],
@@ -376,17 +397,14 @@ class Booster:
 
 def train(params, X, y, *, sample_weight=None, base_margin=None,
           eval_set=None, early_stopping_rounds=None, hist_reduce=None,
-          global_row_count=None, callbacks=None, verbose_eval=False,
-          xgb_model=None):
+          callbacks=None, verbose_eval=False, xgb_model=None):
     """Train a Booster.
 
     :param hist_reduce: optional ``f(np.ndarray) -> np.ndarray`` summing
         histograms across workers — in a HorovodRunner gang this is
         ``hvd.allreduce(op=Sum)``, replacing Rabit (reference
-        ``xgboost.py:61``). Bin edges and row counts must already be
-        consistent across workers (the estimator layer arranges this).
-    :param global_row_count: total rows across all workers (for the
-        default base_score with hist_reduce).
+        ``xgboost.py:61``). Bin edges are made consistent across
+        workers by averaging their quantiles through the same reducer.
     """
     import jax
 
@@ -429,8 +447,13 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     if k > 1 and n_classes < 2:
         raise ValueError("multi:softprob requires num_class >= 2")
 
-    # base score
-    if objective == "reg:squarederror":
+    # base score (a warm start must keep the base its trees were fit
+    # against — recomputing from the new labels would shift every
+    # prediction by the difference)
+    if xgb_model is not None:
+        base_score = xgb_model.base_score
+        base = np.asarray(base_score, np.float32).reshape(-1)
+    elif objective == "reg:squarederror":
         ssum = np.array([np.sum(y * w), np.sum(w)], np.float64)
         if hist_reduce is not None:
             ssum = hist_reduce(ssum)
@@ -447,14 +470,19 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     if xgb_model is not None and trees:
         margins = xgb_model.predict_margin(X) if base_margin is None else margins
 
-    # eval set
+    n_base_trees = len(trees)
+
+    # eval set (warm-start trees must contribute to the metric too)
     ev = None
     if eval_set:
         Xv, yv = eval_set[0]
         Xv = np.asarray(Xv, np.float32)
         yv = np.asarray(yv, np.float32)
         binned_v = np.asarray(bin_data(Xv, edges, missing))
-        margins_v = np.zeros((Xv.shape[0], max(k, 1)), np.float32) + base
+        if xgb_model is not None and n_base_trees:
+            margins_v = xgb_model.predict_margin(Xv).astype(np.float32)
+        else:
+            margins_v = np.zeros((Xv.shape[0], max(k, 1)), np.float32) + base
         ev = (binned_v, yv, margins_v)
 
     # jitted stages, cached per (level, static config)
@@ -578,6 +606,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
         base_score if k <= 1 else base, max(n_classes, k),
         best_iteration=(best_iter if ev is not None
                         and early_stopping_rounds else None),
+        n_base_trees=n_base_trees,
     )
     return booster
 

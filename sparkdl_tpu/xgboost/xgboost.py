@@ -245,46 +245,54 @@ def _fit_booster(params, X, y, w, base_margin, X_val, y_val,
             "(num_workers > 1)."
         )
 
-    def gang_main(params, X, y, w, eval_set, esr, verbose, n_workers,
-                  shuffle):
-        import numpy as np
-
+    def gang_main(params, X, y, w, eval_set, esr, verbose, callbacks,
+                  xgb_model):
         import sparkdl_tpu.hvd as hvd
         from sparkdl_tpu.xgboost import booster as B
 
         hvd.init()
-        rank, nw = hvd.rank(), hvd.size()
-        idx = np.arange(len(X))
-        if shuffle:
-            # force_repartition: deterministic reshuffle so every worker
-            # gets an unbiased shard (reference xgboost.py:72-80).
-            np.random.RandomState(0).shuffle(idx)
-        shard = np.array_split(idx, nw)[rank]
+        rank = hvd.rank()
 
         def hist_reduce(a):
             return hvd.allreduce(a, op=hvd.Sum)
 
         bst = B.train(
-            params, X[shard], y[shard],
-            sample_weight=None if w is None else w[shard],
+            params, X, y, sample_weight=w,
             eval_set=eval_set, early_stopping_rounds=esr,
             verbose_eval=verbose and rank == 0,
-            hist_reduce=hist_reduce,
+            hist_reduce=hist_reduce, callbacks=callbacks,
+            xgb_model=xgb_model,
         )
         return bst if rank == 0 else None
 
-    from sparkdl_tpu.horovod.runner_base import HorovodRunner
+    # Shard rows on the driver so each worker's payload carries ONLY its
+    # shard (the eval set stays replicated: every worker must compute
+    # the identical metric for deterministic early stopping).
+    idx = np.arange(len(X))
+    if force_repartition:
+        # force_repartition: deterministic reshuffle so every worker
+        # gets an unbiased shard (reference xgboost.py:72-80).
+        np.random.RandomState(0).shuffle(idx)
+    shards = np.array_split(idx, num_workers)
+    per_rank = [
+        {"X": X[s], "y": y[s], "w": None if w is None else w[s]}
+        for s in shards
+    ]
+
+    from sparkdl_tpu.horovod.launcher import available_slots, launch_gang
 
     # One boosting worker per task slot (reference xgboost.py:58-64):
     # cluster gang when slots exist, local subprocess gang otherwise.
-    from sparkdl_tpu.horovod.launcher import available_slots
-
     np_arg = num_workers if available_slots() >= num_workers else -num_workers
-    hr = HorovodRunner(np=np_arg)
-    return hr.run(
-        gang_main, params=params, X=X, y=y, w=w, eval_set=eval_set,
-        esr=early_stopping_rounds, verbose=verbose_eval,
-        n_workers=num_workers, shuffle=force_repartition,
+    return launch_gang(
+        np=np_arg, main=gang_main,
+        kwargs=dict(
+            params=params, X=None, y=None, w=None, eval_set=eval_set,
+            esr=early_stopping_rounds, verbose=verbose_eval,
+            callbacks=callbacks, xgb_model=xgb_model,
+        ),
+        driver_log_verbosity="log_callback_only",
+        per_rank_kwargs=per_rank,
     )
 
 
@@ -342,10 +350,16 @@ class _XgboostEstimator(Estimator, _XgboostParams, MLReadable, MLWritable):
             np.save(spill, np.round(X, prec).astype(np.float32))
             X = np.load(spill, mmap_mode="r")
 
-        n_classes = (
-            int(np.unique(y[~np.isnan(y)]).size) if self._is_classifier()
-            else 0
-        )
+        n_classes = 0
+        if self._is_classifier():
+            labels = np.unique(y[~np.isnan(y)])
+            n_classes = int(labels.size)
+            expected = np.arange(n_classes, dtype=labels.dtype)
+            if n_classes < 2 or not np.array_equal(labels, expected):
+                raise ValueError(
+                    "XgboostClassifier requires integer labels "
+                    f"0..k-1 with k>=2; got label values {labels.tolist()}"
+                )
         params = self._booster_params(n_classes)
         callbacks = (
             self.getOrDefault(self.callbacks)

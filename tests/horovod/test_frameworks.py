@@ -167,3 +167,33 @@ def test_tf_local_identities():
             loss = tf.reduce_sum(v * v)
         (g,) = tape.gradient(loss, [v])
         np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_torch_lbfgs_closure_supported():
+    """Closure-requiring optimizers must work through the wrapper
+    (regression: closure was evaluated once and dropped)."""
+    import torch
+
+    import horovod.torch as hvd
+    from sparkdl_tpu.hvd import _state
+
+    with _state.local_mode():
+        hvd.init()
+        torch.manual_seed(0)
+        model = torch.nn.Linear(2, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.LBFGS(model.parameters(), max_iter=4)
+        )
+        x = torch.randn(16, 2)
+        y = x.sum(dim=1, keepdim=True)
+
+        def closure():
+            opt.zero_grad()
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            return loss
+
+        l0 = float(closure())
+        for _ in range(3):
+            loss = opt.step(closure)
+        assert float(loss) < l0

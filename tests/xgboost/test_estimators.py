@@ -188,3 +188,31 @@ def test_distributed_base_margin_rejected():
         XgboostRegressor(
             num_workers=2, baseMarginCol="margin", n_estimators=2
         ).fit(df)
+
+
+def test_noncontiguous_labels_rejected():
+    df = _clf_frame()
+    df["label"] = df["label"] * 2  # {0, 2}
+    with pytest.raises(ValueError, match="0..k-1"):
+        XgboostClassifier(n_estimators=2).fit(df)
+
+
+def test_warm_start_with_early_stopping_keeps_base_trees():
+    df = _reg_frame()
+    m1 = XgboostRegressor(n_estimators=8, max_depth=3).fit(df)
+    m2 = XgboostRegressor(
+        n_estimators=40, max_depth=3, xgb_model=m1.get_booster(),
+        validationIndicatorCol="isVal", early_stopping_rounds=3,
+    ).fit(df)
+    bst = m2.get_booster()
+    assert bst.n_base_trees == 8
+    # truncation keeps the warm-start trees plus the best new rounds
+    if bst.best_iteration is not None:
+        kept = bst.n_base_trees + bst.best_iteration + 1
+        assert kept > 8
+    # continuation should not be worse than the base model
+    p1 = m1.transform(df)["prediction"]
+    p2 = m2.transform(df)["prediction"]
+    r1 = float(np.sqrt(np.mean((p1 - df["label"]) ** 2)))
+    r2 = float(np.sqrt(np.mean((p2 - df["label"]) ** 2)))
+    assert r2 <= r1 + 1e-6
