@@ -1,0 +1,36 @@
+# Sphinx configuration (parity with reference docs/conf.py: autodoc of
+# the public modules with class+__init__ docstrings merged).
+#
+# Build: pushd docs && PYTHONPATH=.. make html   (requires sphinx; the
+# docs build doubles as an import-level integration test of every
+# public module, like the reference CI, reference test.yml:23).
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(".."))
+
+project = "sparkdl-tpu"
+author = "sparkdl-tpu developers"
+
+exec(open("../sparkdl_tpu/version.py").read())  # defines __version__
+version = release = __version__  # noqa: F821
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.napoleon",
+]
+
+# Merge class docstring with __init__ docstring, as the reference does
+# (reference docs/conf.py: autoclass_content='both') — the param
+# contracts live in __init__ docstrings.
+autoclass_content = "both"
+autodoc_member_order = "bysource"
+
+# Heavy optional deps must not break the docs build.
+autodoc_mock_imports = ["tensorflow", "torch", "pyspark"]
+
+master_doc = "index"
+exclude_patterns = ["_build"]
+html_theme = "classic"

@@ -88,12 +88,16 @@ def main():
         if client is not None:
             client.send_ready()
 
-        # 5. Deserialize and run the user main.
+        # 5. Deserialize and run the user main (under a per-rank
+        # profiler trace when SPARKDL_TPU_PROFILE is set).
         import cloudpickle
+
+        from sparkdl_tpu.utils.profiler import maybe_trace_worker
 
         with open(payload_path, "rb") as f:
             user_main, kwargs = cloudpickle.load(f)
-        result = user_main(**kwargs)
+        with maybe_trace_worker(rank):
+            result = user_main(**kwargs)
 
         # 6. Rank 0's return value goes back to the driver.
         if hvd.rank() == 0 and client is not None:

@@ -145,6 +145,32 @@ def barrier():
     engine().barrier()
 
 
+def check_synchronized(tree, name="parameters", atol=0.0):
+    """Gang determinism check (SURVEY.md §5.2): verify a pytree of
+    arrays is identical on every rank — the broadcast-and-compare
+    guard for silent rank divergence (the bug class data-parallel
+    training is most prone to). Raises RuntimeError on drift.
+    """
+    import jax
+
+    _state.require_initialized()
+    if size() == 1:
+        return True
+    for i, leaf in enumerate(jax.tree.leaves(tree)):
+        x = np.ascontiguousarray(to_numpy(leaf), dtype=np.float64)
+        lo = engine().reduce(x, MIN)
+        hi = engine().reduce(x, MAX)
+        drift = float(np.max(np.abs(hi - lo))) if x.size else 0.0
+        if drift > atol:
+            raise RuntimeError(
+                f"{name} leaf #{i} diverged across ranks: max spread "
+                f"{drift:g} (> {atol:g}). Did you forget "
+                "broadcast_parameters/broadcast_variables, or is there "
+                "non-deterministic data-dependent control flow?"
+            )
+    return True
+
+
 def alltoall(tensor, splits=None, name=None):
     """All-to-all. v1 semantics: equal splits along axis 0; implemented
     as allgather + local slice exchange (correct, not yet bandwidth-

@@ -4,6 +4,13 @@ decoder with LoRA — all flax, all written for bf16 MXU math and GSPMD
 sharding via :mod:`sparkdl_tpu.parallel.sharding`.
 """
 
+from sparkdl_tpu.models.bert import (  # noqa: F401
+    Bert,
+    BertConfig,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+)
 from sparkdl_tpu.models.llama import Llama, LlamaConfig  # noqa: F401
 from sparkdl_tpu.models.lora import lora_mask  # noqa: F401
 from sparkdl_tpu.models.mnist_cnn import MnistCNN  # noqa: F401
+from sparkdl_tpu.models.resnet import ResNet, ResNet50  # noqa: F401

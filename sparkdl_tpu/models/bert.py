@@ -1,0 +1,130 @@
+"""BERT encoder (BASELINE.json config 3: BERT-base SQuAD fine-tune),
+flax — bidirectional transformer with learned positions, post-LN
+blocks, GELU MLP, and pooler/QA heads. Module names align with
+TRANSFORMER_RULES for tensor parallelism.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_position: int = 512
+    type_vocab: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_size=128, d_model=32, n_layers=2,
+                        n_heads=2, d_ff=64, max_position=64)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        head_dim = cfg.d_model // cfg.n_heads
+        dense = lambda name: nn.Dense(cfg.d_model, dtype=cfg.dtype, name=name)
+        q = dense("q_proj")(x).reshape(b, s, cfg.n_heads, head_dim)
+        k = dense("k_proj")(x).reshape(b, s, cfg.n_heads, head_dim)
+        v = dense("v_proj")(x).reshape(b, s, cfg.n_heads, head_dim)
+        if attention_mask is not None:
+            # padding mask → big-negative bias on masked keys
+            s_qk = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                              k.astype(jnp.float32)) * (head_dim ** -0.5)
+            bias = jnp.where(attention_mask[:, None, None, :], 0.0, -1e30)
+            p = nn.softmax(s_qk + bias, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        else:
+            o = attention_reference(q, k, v, causal=False)
+        o = o.reshape(b, s, cfg.d_model)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="o_proj")(o)
+
+
+class BertBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       dtype=jnp.float32, name=name)
+        a = BertSelfAttention(cfg, name="attn")(x, attention_mask)
+        x = ln("attn_norm")(x + a)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="fc1")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="fc2")(h)
+        return ln("mlp_norm")(x + h)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="embed")(input_ids)
+        pos = nn.Embed(cfg.max_position, cfg.d_model, dtype=cfg.dtype,
+                       name="pos_embed")(jnp.arange(s)[None, :])
+        x = x + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab, cfg.d_model, dtype=cfg.dtype,
+                             name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="embed_norm")(x)
+        for i in range(cfg.n_layers):
+            x = BertBlock(cfg, name=f"layer_{i}")(x, attention_mask)
+        return x
+
+
+class BertForQuestionAnswering(nn.Module):
+    """Span-prediction head (the SQuAD fine-tune configuration)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = Bert(self.cfg, name="bert")(input_ids, token_type_ids,
+                                        attention_mask)
+        logits = nn.Dense(2, dtype=jnp.float32, name="qa_head")(
+            x.astype(jnp.float32)
+        )
+        start, end = logits[..., 0], logits[..., 1]
+        return start, end
+
+
+class BertForSequenceClassification(nn.Module):
+    cfg: BertConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = Bert(self.cfg, name="bert")(input_ids, token_type_ids,
+                                        attention_mask)
+        pooled = nn.tanh(nn.Dense(self.cfg.d_model, dtype=jnp.float32,
+                                  name="pooler")(x[:, 0].astype(jnp.float32)))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)

@@ -1,0 +1,77 @@
+"""ResNet family (BASELINE.json config 2: ResNet-50/ImageNet), flax.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 conv
+math with fp32 batch-norm statistics, and a fused-friendly
+conv→BN→relu block structure XLA folds into single HBM passes.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = bn(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), (self.strides, self.strides),
+                 name="conv2")(y)
+        y = bn(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        # zero-init the last BN scale: residual branches start as
+        # identity (standard ResNet-50 training recipe)
+        y = bn(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1),
+                            (self.strides, self.strides),
+                            name="conv_proj")(residual)
+            residual = bn(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(
+                    64 * 2 ** i, strides=strides, dtype=self.dtype,
+                    name=f"stage{i}_block{j}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(x.astype(jnp.float32))
+
+
+def ResNet50(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  dtype=dtype)
+
+
+def ResNet18Thin(num_classes=10, dtype=jnp.float32):
+    """CI-size variant (same block machinery, tiny stages)."""
+    return ResNet(stage_sizes=(1, 1), num_classes=num_classes, dtype=dtype)

@@ -73,7 +73,7 @@ TRANSFORMER_RULES = [
     (r"(gate_proj|up_proj|fc1).*kernel", (("fsdp",), "model")),
     (r"(down_proj|fc2).*kernel", ("model", ("fsdp",))),
     (r"lm_head.*kernel", (("fsdp",), "model")),
-    (r"lora_a.*kernel", (None, None)),
-    (r"lora_b.*kernel", (None, "model")),
+    (r"lora_a$", (None, None)),
+    (r"lora_b$", (None, "model")),
     (r"(norm|ln|layernorm).*", ()),
 ]

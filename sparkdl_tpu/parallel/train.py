@@ -21,31 +21,33 @@ def make_train_step(loss_fn, optimizer, *, grad_accum=1, remat=False,
     """Build ``step(params, opt_state, batch, *extra) -> (params,
     opt_state, metrics)``.
 
-    :param loss_fn: ``f(params, batch, *extra) -> scalar loss`` (or
-        ``(loss, aux)`` — detected via has_aux if it returns a tuple).
+    :param loss_fn: ``f(params, batch, *extra) -> scalar loss``.
     :param optimizer: an optax GradientTransformation.
     :param grad_accum: microbatch count; the batch's leading axis is
         split and gradients averaged via ``lax.scan`` (HBM-friendly:
         activations live one microbatch at a time).
     :param remat: wrap loss_fn in ``jax.checkpoint`` — trade FLOPs for
         HBM on long sequences.
-    :param param_mask: optional pytree of bools; False leaves get zero
-        gradients (LoRA-style partial training).
+    :param param_mask: optional pytree of bools; False leaves are
+        frozen (LoRA-style partial training). BOTH gradients and final
+        updates are masked — masking grads alone would let decoupled
+        weight decay (adamw) silently erode frozen weights.
     """
     f = jax.checkpoint(loss_fn) if remat else loss_fn
     grad_fn = jax.value_and_grad(f)
 
-    def apply_mask(grads):
+    def apply_mask(tree):
         if param_mask is None:
-            return grads
+            return tree
         return jax.tree.map(
-            lambda g, m: g if m else jnp.zeros_like(g), grads, param_mask
+            lambda g, m: g if m else jnp.zeros_like(g), tree, param_mask
         )
 
     def single(params, opt_state, batch, *extra):
         loss, grads = grad_fn(params, batch, *extra)
         grads = apply_mask(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates = apply_mask(updates)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, {"loss": loss}
 
@@ -70,6 +72,7 @@ def make_train_step(loss_fn, optimizer, *, grad_accum=1, remat=False,
         grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
         grads = apply_mask(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates = apply_mask(updates)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, {"loss": l_sum / grad_accum}
 

@@ -1,0 +1,77 @@
+"""Checkpoint/resume (SURVEY.md §5.4 — the reference has model
+persistence by contract but NO training checkpointing; here training
+state checkpoints ride orbax, the TPU-native answer, with the same
+save/restore surface the estimators use for models).
+
+Works with sharded (GSPMD) params: orbax restores to the same
+shardings when given an abstract target; in HorovodRunner gangs, rank 0
+coordinates (single-controller semantics are per-process here, so each
+process checkpoints only in single-process or pjit jobs; gang jobs
+should checkpoint from rank 0 — see :func:`should_save`).
+"""
+
+import os
+
+
+def should_save():
+    """In a gang, only rank 0 persists (workers hold replicated state)."""
+    from sparkdl_tpu.hvd import _state
+
+    st = _state.state()
+    return (not st.initialized) or st.rank == 0
+
+
+class TrainCheckpointer:
+    """Step-indexed train-state checkpoints (params, opt_state, extras).
+
+    Thin wrapper over ``orbax.checkpoint.CheckpointManager`` with
+    keep-last-N retention and atomic writes.
+    """
+
+    def __init__(self, directory, max_to_keep=3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step, state, force=False):
+        """state: any pytree (e.g. {'params': ..., 'opt_state': ...})."""
+        import orbax.checkpoint as ocp
+
+        if not should_save():
+            return False
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        self._mgr.wait_until_finished()
+        return saved
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def restore(self, step=None, target=None):
+        """Restore a step (default latest). Pass ``target`` (a pytree of
+        like-shaped arrays or jax.ShapeDtypeStruct with shardings) to
+        control placement of the restored arrays."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints found under {self._dir}"
+            )
+        if target is not None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
+        return self._mgr.restore(step)
+
+    def close(self):
+        self._mgr.close()

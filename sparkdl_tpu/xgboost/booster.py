@@ -467,8 +467,13 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     margins = np.zeros((n, max(k, 1)), np.float32) + base
     if base_margin is not None:
         margins += np.asarray(base_margin, np.float32).reshape(n, -1)
-    if xgb_model is not None and trees:
-        margins = xgb_model.predict_margin(X) if base_margin is None else margins
+    if xgb_model is not None and trees and base_margin is None:
+        # Continuation keeps ALL base trees, so the starting margins
+        # must come from the FULL base forest — not the base model's
+        # best_iteration truncation — or the new trees would be fit
+        # against residuals inconsistent with prediction time.
+        full_range = (0, len(trees) // max(k, 1))
+        margins = xgb_model.predict_margin(X, iteration_range=full_range)
 
     n_base_trees = len(trees)
 
@@ -480,7 +485,9 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
         yv = np.asarray(yv, np.float32)
         binned_v = np.asarray(bin_data(Xv, edges, missing))
         if xgb_model is not None and n_base_trees:
-            margins_v = xgb_model.predict_margin(Xv).astype(np.float32)
+            margins_v = xgb_model.predict_margin(
+                Xv, iteration_range=(0, n_base_trees // max(k, 1))
+            ).astype(np.float32)
         else:
             margins_v = np.zeros((Xv.shape[0], max(k, 1)), np.float32) + base
         ev = (binned_v, yv, margins_v)

@@ -48,7 +48,8 @@ def test_lora_train_step_updates_only_adapters(setup):
     shardings = param_sharding(params, TRANSFORMER_RULES, mesh)
     params = jax.device_put(params, shardings)
     mask = lora_mask(params)
-    opt = optax.adam(1e-2)
+    opt = optax.adamw(1e-2, weight_decay=0.1)  # wd would expose
+    # frozen-param erosion if updates were not masked
     opt_state = opt.init(params)
 
     def loss_fn(p, batch):

@@ -1,0 +1,105 @@
+"""Auxiliary subsystems (SURVEY.md §5): checkpoint/resume, profiling,
+gang determinism checking."""
+
+import numpy as np
+import pytest
+
+from sparkdl import HorovodRunner
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    state = {
+        "params": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)},
+        "step": jnp.asarray(7),
+    }
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"), max_to_keep=2)
+    try:
+        assert ckpt.save(0, state)
+        state2 = {
+            "params": {"w": state["params"]["w"] * 2, "b": state["params"]["b"]},
+            "step": jnp.asarray(8),
+        }
+        ckpt.save(1, state2)
+        assert ckpt.latest_step() == 1
+        restored = ckpt.restore()
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]),
+            np.asarray(state2["params"]["w"]),
+        )
+        # retention: old steps pruned beyond max_to_keep
+        ckpt.save(2, state2)
+        ckpt.save(3, state2)
+        assert ckpt.latest_step() == 3
+    finally:
+        ckpt.close()
+
+
+def test_checkpoint_restore_empty_raises(tmp_path):
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    ckpt = TrainCheckpointer(str(tmp_path / "empty"))
+    try:
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore()
+    finally:
+        ckpt.close()
+
+
+def test_profiler_trace_writes_files(tmp_path):
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.utils.profiler import annotate, trace
+
+    d = str(tmp_path / "trace")
+    with trace(d):
+        with annotate("test-region"):
+            (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    import os
+
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "profiler produced no trace files"
+
+
+@pytest.mark.gang
+def test_check_synchronized_detects_divergence():
+    def main():
+        import numpy as np
+
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        synced = np.ones((4,), np.float32)
+        hvd.check_synchronized({"w": synced})  # identical → fine
+        diverged = np.ones((4,), np.float32) * (hvd.rank() + 1)
+        try:
+            hvd.check_synchronized({"w": diverged})
+            return "no-error"
+        except RuntimeError as e:
+            return "caught" if "diverged" in str(e) else "wrong-error"
+
+    assert HorovodRunner(np=-2).run(main) == "caught"
+
+
+@pytest.mark.gang
+def test_worker_profiling_env(tmp_path, monkeypatch):
+    """SPARKDL_TPU_PROFILE on the driver → per-rank trace dirs."""
+    monkeypatch.setenv("SPARKDL_TPU_PROFILE", str(tmp_path / "prof"))
+
+    def main():
+        import jax.numpy as jnp
+
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+        return hvd.size()
+
+    assert HorovodRunner(np=-2).run(main) == 2
+    assert (tmp_path / "prof" / "rank-0").exists()
+    assert (tmp_path / "prof" / "rank-1").exists()
