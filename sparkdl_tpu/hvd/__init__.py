@@ -150,24 +150,67 @@ def check_synchronized(tree, name="parameters", atol=0.0):
     arrays is identical on every rank — the broadcast-and-compare
     guard for silent rank divergence (the bug class data-parallel
     training is most prone to). Raises RuntimeError on drift.
+
+    ``atol=0`` (default) compares raw BYTES via an allgathered digest —
+    exact at full precision (float64 included, NaN == same-bits NaN),
+    and a single collective for the whole tree. ``atol > 0`` uses one
+    fused min/max reduction over a flat float32 buffer (tolerances
+    below float32 resolution are not detectable in that mode).
     """
+    import hashlib
+
     import jax
 
     _state.require_initialized()
     if size() == 1:
         return True
-    for i, leaf in enumerate(jax.tree.leaves(tree)):
-        x = np.ascontiguousarray(to_numpy(leaf), dtype=np.float64)
-        lo = engine().reduce(x, MIN)
-        hi = engine().reduce(x, MAX)
-        drift = float(np.max(np.abs(hi - lo))) if x.size else 0.0
-        if drift > atol:
+    leaves = [np.ascontiguousarray(to_numpy(l)) for l in jax.tree.leaves(tree)]
+    hint = (
+        "Did you forget broadcast_parameters/broadcast_variables, or is "
+        "there non-deterministic data-dependent control flow?"
+    )
+    if atol == 0.0:
+        h = hashlib.sha256()
+        for x in leaves:
+            h.update(x.tobytes())
+        digest = np.frombuffer(h.digest(), np.uint8).copy()
+        all_digests = engine().allgather(digest[None, :])
+        if not (all_digests == all_digests[0]).all():
+            bad = [r for r in range(size())
+                   if not (all_digests[r] == all_digests[0]).all()]
             raise RuntimeError(
-                f"{name} leaf #{i} diverged across ranks: max spread "
-                f"{drift:g} (> {atol:g}). Did you forget "
-                "broadcast_parameters/broadcast_variables, or is there "
-                "non-deterministic data-dependent control flow?"
+                f"{name} diverged across ranks (bytewise digest mismatch "
+                f"vs rank 0 on ranks {bad}). {hint}"
             )
+        return True
+    # numeric mode: ONE min + ONE max reduce over the fused buffer
+    flat = np.concatenate(
+        [x.astype(np.float32).ravel() for x in leaves]
+    ) if leaves else np.zeros((0,), np.float32)
+    lo = engine().reduce(flat, MIN)
+    hi = engine().reduce(flat, MAX)
+    spread = hi - lo
+    if not np.isfinite(spread).all():
+        # NaN/Inf on some rank: pmin/pmax propagate it; a NaN spread
+        # must fail loudly, not compare False against atol.
+        raise RuntimeError(
+            f"{name} contains non-finite divergence across ranks "
+            "(NaN/Inf on some rank but not others, or Inf-Inf). " + hint
+        )
+    drift = float(spread.max()) if flat.size else 0.0
+    if drift > atol:
+        # localize the worst leaf for the error message
+        offset, worst = 0, (0, 0.0)
+        for i, x in enumerate(leaves):
+            n = x.size
+            d = float(spread[offset:offset + n].max()) if n else 0.0
+            if d > worst[1]:
+                worst = (i, d)
+            offset += n
+        raise RuntimeError(
+            f"{name} diverged across ranks: max spread {drift:g} "
+            f"(> {atol:g}) at leaf #{worst[0]}. {hint}"
+        )
     return True
 
 

@@ -1,0 +1,3 @@
+"""TPU kernels (pallas) and kernel-dispatching ops."""
+
+from sparkdl_tpu.ops.attention import flash_attention  # noqa: F401

@@ -1,0 +1,94 @@
+"""Attention dispatch: pallas flash kernel on TPU, XLA reference
+elsewhere, with padding and layout handling.
+
+Public shape convention matches the models: (batch, seq, heads,
+head_dim). Gradients flow through a custom_vjp whose backward
+recomputes via the XLA reference path (fused backward kernel is on the
+kernel roadmap; the forward kernel is what serving latency sees).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _pad_to(x, multiple, axis):
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), pad
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret):
+    from sparkdl_tpu.ops.pallas.flash_attention import flash_attention_bhsd
+
+    # (B, S, H, D) -> (B, H, S, D); pad S to the 128 tile
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    s = qt.shape[2]
+    block = 128 if s >= 128 else max(8, s)
+    qt, pad = _pad_to(qt, block, 2)
+    kt, _ = _pad_to(kt, block, 2)
+    vt, _ = _pad_to(vt, block, 2)
+    if pad and not causal:
+        # padded keys must not receive attention weight: causal masking
+        # already excludes them for causal=True (queries come first);
+        # for bidirectional attention fall back to the reference path.
+        return attention_reference(q, k, v, causal=False, scale=scale)
+    out = flash_attention_bhsd(
+        qt, kt, vt, causal=causal, scale=scale, bq=block, bk=block,
+        interpret=interpret,
+    )
+    if pad:
+        out = out[:, :, : s, :]
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, interpret):
+    return _flash_fwd(q, k, v, causal, scale, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, interpret):
+    return _flash_fwd(q, k, v, causal, scale, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None):
+    """Fused attention on (batch, seq, heads, head_dim) tensors.
+
+    Uses the pallas TPU kernel when running on TPU (or when
+    ``interpret=True`` for testing on CPU); otherwise the XLA reference
+    implementation.
+    """
+    if interpret is None:
+        if not _use_pallas():
+            return attention_reference(q, k, v, causal=causal, scale=scale)
+        interpret = False
+    return _flash(q, k, v, causal, scale, interpret)

@@ -1,0 +1,119 @@
+"""Pallas TPU flash attention (forward).
+
+The hot op of every transformer in the model zoo. Design (per the
+pallas TPU playbook):
+
+- grid ``(batch, heads, q_blocks)``; each program holds one q tile in
+  VMEM and streams K/V tiles of its (batch, head) slice through the
+  MXU, maintaining the numerically stable running-softmax state
+  (m, l, acc) in fp32 registers — attention scores never materialize
+  in HBM, so memory is O(S·D) instead of O(S²).
+- causal masking prunes the k-loop: q block i only visits k blocks
+  ``<= ceil((i+1)·BQ / BK)`` (no wasted MXU work on fully-masked
+  tiles); the partial diagonal tile is masked with an iota compare.
+- fp32 accumulation with ``preferred_element_type`` on both matmuls;
+  bf16 inputs hit the MXU natively.
+
+The public wrapper pads S to the tile size and handles (B, S, H, D)
+layout; backward currently recomputes through the XLA reference path
+via custom_vjp (a fused backward kernel is the next kernel on the
+roadmap — forward is where inference/serving time goes).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _make_kernel(bq, bk, seq_len, causal, scale):
+    from jax.experimental import pallas as pl
+
+    n_k_blocks = seq_len // bk
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(2)
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+        d = q.shape[-1]
+
+        def body(j, carry):
+            m, l, acc = carry
+            kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            s_ij = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                 # (bq, bk)
+            if causal:
+                q_pos = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
+                k_pos = j * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1
+                )
+                s_ij = jnp.where(q_pos >= k_pos, s_ij, NEG_INF)
+            m_blk = jnp.max(s_ij, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp(s_ij - m_new[:, None])
+            p = jnp.where((m_new <= NEG_INF / 2)[:, None], 0.0, p)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            pv = jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_new = acc * alpha[:, None] + pv
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((bq,), jnp.float32)
+        acc0 = jnp.zeros((bq, q.shape[-1]), jnp.float32)
+        if causal:
+            # last k block this q block can see (prunes future tiles)
+            upper = jnp.minimum(
+                (qi * bq + bq + bk - 1) // bk, n_k_blocks
+            )
+        else:
+            upper = n_k_blocks
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        out = acc / jnp.maximum(l, 1e-30)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+    return kernel
+
+
+def flash_attention_bhsd(q, k, v, *, causal=True, scale=None, bq=128,
+                         bk=128, interpret=False):
+    """Flash attention on (batch, heads, seq, head_dim) arrays.
+
+    seq must be divisible by the block sizes (the public wrapper in
+    :mod:`sparkdl_tpu.ops.attention` pads).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    scale = scale or (d ** -0.5)
+    bq = min(bq, s)
+    bk = min(bk, s)
+    if s % bq or s % bk:
+        raise ValueError(f"seq {s} must be divisible by bq={bq}, bk={bk}")
+
+    kernel = _make_kernel(bq, bk, s, causal, scale)
+    grid = (b, h, s // bq)
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel"),
+        ),
+        interpret=interpret,
+    )(q, k, v)

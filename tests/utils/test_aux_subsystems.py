@@ -103,3 +103,37 @@ def test_worker_profiling_env(tmp_path, monkeypatch):
     assert HorovodRunner(np=-2).run(main) == 2
     assert (tmp_path / "prof" / "rank-0").exists()
     assert (tmp_path / "prof" / "rank-1").exists()
+
+
+@pytest.mark.gang
+def test_check_synchronized_nan_and_tolerance_modes():
+    def main():
+        import numpy as np
+
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        results = []
+        # numeric mode with tolerance: small drift under atol passes
+        x = np.ones((4,), np.float32) + hvd.rank() * 1e-6
+        hvd.check_synchronized({"w": x}, atol=1e-3)
+        results.append("tol-ok")
+        # NaN on one rank only must fail loudly in numeric mode
+        bad = np.ones((4,), np.float32)
+        if hvd.rank() == 0:
+            bad[0] = np.nan
+        try:
+            hvd.check_synchronized({"w": bad}, atol=1e-3)
+            results.append("nan-missed")
+        except RuntimeError as e:
+            results.append("nan-caught" if "non-finite" in str(e)
+                           else "nan-wrong-msg")
+        # exact mode: identical NaNs on all ranks are synchronized
+        same_nan = np.full((2,), np.nan, np.float32)
+        hvd.check_synchronized({"w": same_nan})
+        results.append("same-nan-ok")
+        return results
+
+    assert HorovodRunner(np=-2).run(main) == [
+        "tol-ok", "nan-caught", "same-nan-ok"
+    ]
