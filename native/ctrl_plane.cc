@@ -1,0 +1,247 @@
+// sparkdl-tpu native control-plane transport.
+//
+// The reference's log channel is a stub backed by closed-source
+// Databricks Runtime (reference sparkdl/horovod/__init__.py:20-25);
+// its one performance clause is that driver-log streaming must not
+// stall training (reference runner_base.py:65-68). This module is the
+// native piece that enforces it: a bounded in-memory ring of framed
+// messages drained by a background sender thread over TCP. Producers
+// (the Python log tee, called on the training thread) only memcpy into
+// the ring; when the ring is full the OLDEST frames are dropped and
+// counted — log pressure can never block a training step on socket
+// backpressure.
+//
+// Frame format matches the Python control plane
+// (sparkdl_tpu/horovod/control_plane.py): u32 len | u8 type | u32 rank,
+// big-endian, len = payload + 5.
+//
+// C API (ctypes-friendly), all functions thread-safe:
+//   void*    sdl_sender_create(host, port, rank, capacity_bytes)
+//   int      sdl_sender_send(s, type, payload, len)   // 0 ok, 1 dropped
+//   uint64_t sdl_sender_dropped(s)
+//   int      sdl_sender_flush(s, timeout_ms)          // 0 drained
+//   void     sdl_sender_close(s)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Frame {
+  uint8_t type;
+  std::vector<uint8_t> payload;
+};
+
+class Sender {
+ public:
+  Sender(const std::string& host, int port, uint32_t rank,
+         size_t capacity_bytes)
+      : host_(host), port_(port), rank_(rank),
+        capacity_(capacity_bytes), fd_(-1) {
+    thread_ = std::thread([this] { Drain(); });
+  }
+
+  ~Sender() { Close(); }
+
+  // Enqueue a frame; drops oldest frames when over capacity.
+  // Returns 0 on enqueue, 1 if this or older frames were dropped.
+  int Send(uint8_t type, const uint8_t* payload, uint32_t len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (closed_) return 1;
+    if (len > capacity_) {  // single frame larger than the ring:
+      // reject it alone — evicting the backlog would gain nothing
+      dropped_.fetch_add(1, std::memory_order_relaxed);
+      return 1;
+    }
+    int dropped_now = 0;
+    while (!queue_.empty() && bytes_ + len > capacity_) {
+      bytes_ -= queue_.front().payload.size();
+      queue_.pop_front();
+      dropped_.fetch_add(1, std::memory_order_relaxed);
+      dropped_now = 1;
+    }
+    Frame f;
+    f.type = type;
+    f.payload.assign(payload, payload + len);
+    bytes_ += len;
+    queue_.push_back(std::move(f));
+    cv_.notify_one();
+    return dropped_now;
+  }
+
+  uint64_t Dropped() const {
+    return dropped_.load(std::memory_order_relaxed);
+  }
+
+  // Block until queued AND in-flight frames are transmitted (or
+  // timeout). 0 = fully drained.
+  int Flush(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool ok = drained_cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms),
+        [this] { return (queue_.empty() && !in_flight_) || closed_; });
+    return ok && queue_.empty() && !in_flight_ ? 0 : 1;
+  }
+
+  void Close() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (closed_) return;
+      closed_ = true;
+      // Abandon any backlog: orderly shutdowns Flush() first; a close
+      // with frames left means the peer is gone or the caller doesn't
+      // care — never hang the worker on it.
+      dropped_.fetch_add(queue_.size(), std::memory_order_relaxed);
+      queue_.clear();
+      bytes_ = 0;
+      // Interrupt a drain thread blocked inside ::send/::connect.
+      if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+      cv_.notify_all();
+      drained_cv_.notify_all();
+    }
+    if (thread_.joinable()) thread_.join();
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+ private:
+  bool Connect() {
+    struct addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port_);
+    if (getaddrinfo(host_.c_str(), port_s.c_str(), &hints, &res) != 0) {
+      return false;
+    }
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+      freeaddrinfo(res);
+      return false;
+    }
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      ::close(fd);
+      freeaddrinfo(res);
+      return false;
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fd_ = fd;
+    return true;
+  }
+
+  bool SendAll(const uint8_t* data, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = ::send(fd_, data + off, n - off, MSG_NOSIGNAL);
+      if (w <= 0) return false;
+      off += static_cast<size_t>(w);
+    }
+    return true;
+  }
+
+  void Drain() {
+    while (true) {
+      Frame f;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return closed_ || !queue_.empty(); });
+        if (closed_) return;  // Close() abandoned the backlog
+        f = std::move(queue_.front());
+        queue_.pop_front();
+        bytes_ -= f.payload.size();
+        in_flight_ = true;
+      }
+      bool sent = true;
+      if (fd_ < 0 && !Connect()) {
+        // Driver unreachable: count as dropped, keep training alive.
+        dropped_.fetch_add(1, std::memory_order_relaxed);
+        sent = false;
+      } else {
+        uint32_t len = htonl(static_cast<uint32_t>(f.payload.size()) + 5);
+        uint32_t rank_be = htonl(rank_);
+        uint8_t header[9];
+        std::memcpy(header, &len, 4);
+        header[4] = f.type;
+        std::memcpy(header + 5, &rank_be, 4);
+        if (!SendAll(header, 9) ||
+            !SendAll(f.payload.data(), f.payload.size())) {
+          ::close(fd_);
+          fd_ = -1;
+          dropped_.fetch_add(1, std::memory_order_relaxed);
+          sent = false;
+        }
+      }
+      (void)sent;
+      {
+        // Signal drained only AFTER the frame hit the socket —
+        // Flush() returning must mean the bytes left this process.
+        std::unique_lock<std::mutex> lk(mu_);
+        in_flight_ = false;
+        if (queue_.empty()) drained_cv_.notify_all();
+      }
+    }
+  }
+
+  std::string host_;
+  int port_;
+  uint32_t rank_;
+  size_t capacity_;
+  int fd_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable drained_cv_;
+  std::deque<Frame> queue_;
+  size_t bytes_ = 0;
+  bool closed_ = false;
+  bool in_flight_ = false;
+  std::atomic<uint64_t> dropped_{0};
+  std::thread thread_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sdl_sender_create(const char* host, int port, uint32_t rank,
+                        size_t capacity_bytes) {
+  return new Sender(host, port, rank, capacity_bytes);
+}
+
+int sdl_sender_send(void* s, uint8_t type, const uint8_t* payload,
+                    uint32_t len) {
+  return static_cast<Sender*>(s)->Send(type, payload, len);
+}
+
+uint64_t sdl_sender_dropped(void* s) {
+  return static_cast<Sender*>(s)->Dropped();
+}
+
+int sdl_sender_flush(void* s, int timeout_ms) {
+  return static_cast<Sender*>(s)->Flush(timeout_ms);
+}
+
+void sdl_sender_close(void* s) {
+  Sender* sender = static_cast<Sender*>(s);
+  sender->Close();
+  delete sender;
+}
+
+}  // extern "C"

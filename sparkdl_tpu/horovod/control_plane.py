@@ -252,7 +252,15 @@ class ControlPlaneServer:
 
 
 class ControlPlaneClient:
-    """Worker-side client for the driver control plane."""
+    """Worker-side client for the driver control plane.
+
+    Control messages (READY/RESULT/EXC/BYE) go over a blocking Python
+    socket — they must arrive. Log traffic (LOG/USERLOG) prefers the
+    native C++ transport (:mod:`sparkdl_tpu.native`): a bounded
+    drop-oldest ring drained off-thread, so log volume can never stall
+    the training thread (reference ``runner_base.py:65-68``). Set
+    ``SPARKDL_TPU_NATIVE_LOGS=0`` to force the Python path.
+    """
 
     def __init__(self, address, rank):
         host, port = address.rsplit(":", 1)
@@ -260,6 +268,14 @@ class ControlPlaneClient:
         self._sock = socket.create_connection((host, int(port)), timeout=30)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
+        self._native = None
+        if os.environ.get("SPARKDL_TPU_NATIVE_LOGS", "1") != "0":
+            try:
+                from sparkdl_tpu.native import NativeLogSender
+
+                self._native = NativeLogSender(host, int(port), rank)
+            except (RuntimeError, OSError):
+                self._native = None
 
     def _send(self, mtype, payload):
         frame = _HEADER.pack(len(payload) + 5, mtype, self.rank) + payload
@@ -276,9 +292,22 @@ class ControlPlaneClient:
         self._send(MSG_READY, b"")
 
     def send_log(self, stream, text):
-        self._send_json(MSG_LOG, {"stream": stream, "text": text[:MAX_LOG_TEXT]})
+        # High-volume tee'd stdout/stderr rides the native drop-oldest
+        # ring (never blocks training).
+        payload = json.dumps(
+            {"stream": stream, "text": text[:MAX_LOG_TEXT]}
+        ).encode("utf-8")
+        native = self._native
+        if native is not None:
+            native.send(MSG_LOG, payload)
+        else:
+            self._send(MSG_LOG, payload)
 
     def send_user_log(self, text):
+        # log_to_driver is low-rate and EXPLICIT — it takes the
+        # guaranteed control socket, never the droppable ring
+        # (reference contract: the driver prints it,
+        # sparkdl/horovod/__init__.py:20-25).
         self._send_json(MSG_USERLOG, {"text": text[:MAX_LOG_TEXT]})
 
     def send_result(self, pickled_bytes):
@@ -291,9 +320,19 @@ class ControlPlaneClient:
         self._send_json(MSG_EXC, {"traceback": tb_text})
 
     def send_bye(self, exit_code):
+        # Drain buffered logs before announcing exit so the job log is
+        # complete for clean shutdowns (drops only happen under flood).
+        if self._native is not None:
+            self._native.flush(timeout_ms=5000)
         self._send_json(MSG_BYE, {"exit_code": exit_code})
 
     def close(self):
+        # Detach first so racing send_log calls see None (and the
+        # sender's own lock makes a send that already grabbed the
+        # reference safe against the close).
+        native, self._native = self._native, None
+        if native is not None:
+            native.close()
         try:
             self._sock.close()
         except OSError:

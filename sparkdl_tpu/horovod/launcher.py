@@ -205,6 +205,16 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
             payload_paths = [path] * num_workers
             break
 
+    # Prebuild the native log transport once on the driver so workers
+    # don't each pay (or race) the compile inside the gang start
+    # timeout; workers then dlopen the cached .so.
+    try:
+        from sparkdl_tpu.native import load_ctrl_lib
+
+        load_ctrl_lib()
+    except Exception:  # pragma: no cover - never block launch on this
+        pass
+
     server = ControlPlaneServer(
         num_workers,
         verbosity=driver_log_verbosity,
