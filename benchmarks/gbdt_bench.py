@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""GBDT training throughput on the local chip (the sparkdl.xgboost
+path, BASELINE.json config 4)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import numpy as np
+
+
+def main():
+    import pandas as pd
+
+    from sparkdl.xgboost import XgboostClassifier
+
+    rng = np.random.RandomState(0)
+    n, f = 100_000, 32
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, :4].sum(axis=1) + 0.1 * rng.randn(n) > 0).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    clf = XgboostClassifier(n_estimators=20, max_depth=5, max_bin=256)
+    t0 = time.perf_counter()
+    model = clf.fit(df)
+    fit_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = model.transform(df)
+    pred_s = time.perf_counter() - t0
+    acc = float((out["prediction"] == df["label"]).mean())
+
+    print(json.dumps({
+        "benchmark": "gbdt_train_throughput",
+        "rows": n, "features": f, "trees": 20, "max_depth": 5,
+        "fit_sec": round(fit_s, 2),
+        "rows_per_sec_fit": round(n * 20 / fit_s, 0),
+        "predict_sec": round(pred_s, 2),
+        "train_accuracy": round(acc, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

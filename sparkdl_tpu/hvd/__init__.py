@@ -98,8 +98,28 @@ def allreduce(tensor, average=None, name=None, op=None):
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None):
+    """Fused allreduce of a tensor list: one collective per dtype
+    (Horovod tensor-fusion semantics) instead of one per tensor."""
     del name
-    return [allreduce(t, average=average, op=op) for t in tensors]
+    _state.require_initialized()
+    kind = _resolve_op(average, op)
+    arrays = [np.ascontiguousarray(to_numpy(t)) for t in tensors]
+    by_dtype = {}
+    for i, a in enumerate(arrays):
+        by_dtype.setdefault(a.dtype, []).append(i)
+    out = [None] * len(arrays)
+    for dtype, idxs in by_dtype.items():
+        flat = np.concatenate([arrays[i].ravel() for i in idxs]) \
+            if len(idxs) > 1 else arrays[idxs[0]].ravel()
+        red = engine().reduce(flat, kind)
+        offset = 0
+        for i in idxs:
+            n = arrays[i].size
+            out[i] = from_numpy_like(
+                red[offset:offset + n].reshape(arrays[i].shape), tensors[i]
+            )
+            offset += n
+    return out
 
 
 def allgather(tensor, name=None):
@@ -215,9 +235,9 @@ def check_synchronized(tree, name="parameters", atol=0.0):
 
 
 def alltoall(tensor, splits=None, name=None):
-    """All-to-all. v1 semantics: equal splits along axis 0; implemented
-    as allgather + local slice exchange (correct, not yet bandwidth-
-    optimal; a ppermute-based path is on the roadmap)."""
+    """All-to-all along axis 0. Equal splits run as ONE XLA all_to_all
+    over the interconnect; ragged splits pad to the max split, exchange,
+    and trim (one size exchange + one all_to_all)."""
     del name
     _state.require_initialized()
     n = size()
@@ -237,17 +257,28 @@ def alltoall(tensor, splits=None, name=None):
         )
     if n == 1:
         return from_numpy_like(x.copy(), tensor)
-    # Exchange split tables, gather everything, then pick my slices.
-    split_table = engine().allgather(np.asarray(splits, np.int64)[None, :])
-    gathered = engine().allgather(np.ascontiguousarray(x))
+    eng = engine()
+    # The uniform-vs-ragged decision MUST be made from the globally
+    # exchanged table — deciding from rank-local splits lets ranks
+    # take different collective sequences and deadlock the gang.
+    split_table = eng.allgather(np.asarray(splits, np.int64)[None, :])
+    if (split_table == split_table.flat[0]).all():
+        out = eng.alltoall_equal(np.ascontiguousarray(x))
+        return from_numpy_like(out, tensor)
+    # Ragged: everyone pads each destination chunk to the global max
+    # split, one equal all_to_all, then trim using the exchanged table.
+    max_split = int(split_table.max())
+    padded = np.zeros((n * max_split,) + x.shape[1:], x.dtype)
+    off = 0
+    for j, s in enumerate(splits):
+        padded[j * max_split : j * max_split + s] = x[off : off + s]
+        off += s
+    out = eng.alltoall_equal(padded)
     r = rank()
-    parts = []
-    row_start = 0
-    for src in range(n):
-        src_splits = split_table[src]
-        offset = row_start + int(src_splits[:r].sum())
-        parts.append(gathered[offset : offset + int(src_splits[r])])
-        row_start += int(src_splits.sum())
+    parts = [
+        out[src * max_split : src * max_split + int(split_table[src, r])]
+        for src in range(n)
+    ]
     return from_numpy_like(np.concatenate(parts, axis=0), tensor)
 
 

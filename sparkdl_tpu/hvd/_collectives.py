@@ -75,21 +75,35 @@ class _CollectiveEngine:
         elif kind == "gather":
             # tiled all_gather along leading axis
             body = lambda x: jax.lax.all_gather(x, "hvd", axis=0, tiled=True)
+        elif kind == "alltoall":
+            # shard_map block (1, n*chunk, ...): exchange chunk j with
+            # rank j in one collective (XLA all-to-all over ICI).
+            def body(x):
+                blk = x[0]  # (n*chunk, ...)
+                n = jax.lax.axis_size("hvd")
+                parts = blk.reshape((n, blk.shape[0] // n) + blk.shape[1:])
+                out = jax.lax.all_to_all(
+                    parts, "hvd", split_axis=0, concat_axis=0, tiled=False
+                )
+                return out.reshape(blk.shape)[None]
         else:
             raise ValueError(kind)
-        # all_gather(tiled) output is replicated, but shard_map's static
-        # replication checker can't infer that — disable the check for
-        # the gather program only.
-        extra = {"check_vma": False} if kind == "gather" else {}
+        # alltoall outputs stay partitioned (each rank receives its own
+        # slices); reductions/gathers replicate. The replication checker
+        # can't infer all_gather/all_to_all semantics — disable for those.
+        out_spec = P("hvd") if kind == "alltoall" else P()
+        extra = (
+            {"check_vma": False} if kind in ("gather", "alltoall") else {}
+        )
         with self._lock:
             fn = self._fns.get(key)
             if fn is None:
                 fn = jax.jit(
                     jax.shard_map(
-                        body, mesh=mesh, in_specs=P("hvd"), out_specs=P(),
-                        **extra,
+                        body, mesh=mesh, in_specs=P("hvd"),
+                        out_specs=out_spec, **extra,
                     ),
-                    out_shardings=NamedSharding(mesh, P()),
+                    out_shardings=NamedSharding(mesh, out_spec),
                 )
                 self._fns[key] = fn
         return fn
@@ -163,6 +177,17 @@ class _CollectiveEngine:
         gathered = self._local_out(fn(self._to_global(padded)))
         parts = [gathered[r, : int(sizes[r])] for r in range(st.size)]
         return np.concatenate(parts, axis=0)
+
+    def alltoall_equal(self, x_np):
+        """Equal-split all-to-all: local (n*chunk, ...) in, local
+        (n*chunk, ...) out where slot j holds rank j's chunk for us —
+        ONE XLA all_to_all over the interconnect (not gather+slice)."""
+        st = _state.state()
+        if st.size == 1:
+            return x_np.copy()
+        fn = self._compiled("alltoall", x_np.shape, x_np.dtype)
+        out = fn(self._to_global(x_np))
+        return np.asarray(out.addressable_shards[0].data)[0]
 
     def broadcast(self, x_np, root_rank):
         st = _state.state()

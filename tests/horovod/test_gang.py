@@ -111,3 +111,69 @@ def test_oversized_log_line_does_not_poison_control_plane(capfd):
         return hvd.size()
 
     assert HorovodRunner(np=-2, driver_log_verbosity="all").run(noisy_main) == 2
+
+
+@pytest.mark.gang
+def test_alltoall_and_grouped_allreduce():
+    def main():
+        import numpy as np
+
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        # equal alltoall: rank r sends [r*10+j]*2 to rank j
+        x = np.concatenate(
+            [np.full((2,), r * 10 + j, np.float32) for j in range(n)]
+        )
+        eq = hvd.alltoall(x)
+        # ragged alltoall: rank r sends j+1 rows of value r*10+j to rank j
+        parts = [np.full((j + 1,), r * 10 + j, np.float32) for j in range(n)]
+        rag = hvd.alltoall(np.concatenate(parts), splits=[j + 1 for j in range(n)])
+        # grouped allreduce: mixed dtypes fused per dtype
+        g = hvd.grouped_allreduce(
+            [np.ones((3,), np.float32) * (r + 1),
+             np.ones((2, 2), np.float64) * (r + 1),
+             np.ones((4,), np.float32) * 10 * (r + 1)],
+            op=hvd.Sum,
+        )
+        return {
+            "rank": r,
+            "eq": eq.tolist(),
+            "rag": rag.tolist(),
+            "g0": g[0].tolist(), "g1": np.asarray(g[1]).tolist(),
+            "g2": g[2].tolist(),
+        }
+
+    out = HorovodRunner(np=-2).run(main)
+    r = out["rank"]
+    assert r == 0
+    # rank 0 receives from rank 0: [0*10+0]*2, from rank 1: [1*10+0]*2
+    assert out["eq"] == [0.0, 0.0, 10.0, 10.0]
+    # ragged: rank 0 gets 1 row from each source: [0*10+0, 1*10+0]
+    assert out["rag"] == [0.0, 10.0]
+    assert out["g0"] == [3.0, 3.0, 3.0]          # (1+2)
+    assert out["g1"] == [[3.0, 3.0], [3.0, 3.0]]
+    assert out["g2"] == [30.0, 30.0, 30.0, 30.0]
+
+
+@pytest.mark.gang
+def test_alltoall_rank_divergent_splits():
+    """Regression: ranks passing different split patterns (one locally
+    uniform, one ragged) must agree on the collective sequence."""
+
+    def main():
+        import numpy as np
+
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        # rank 0: [2,2] (locally uniform); rank 1: [1,3] (ragged)
+        splits = [2, 2] if r == 0 else [1, 3]
+        x = np.arange(sum(splits), dtype=np.float32) + 100 * r
+        out = hvd.alltoall(x, splits=splits)
+        return out.tolist() if r == 0 else None
+
+    # rank 0 receives rank0's chunk0 ([0,1]) + rank1's chunk0 ([100])
+    assert HorovodRunner(np=-2).run(main) == [0.0, 1.0, 100.0]
