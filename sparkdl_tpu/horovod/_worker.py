@@ -55,6 +55,20 @@ class _TeeStream(io.TextIOBase):
         return True
 
 
+def _set_parent_death_signal():
+    """Linux second line of defense: SIGTERM this worker if its parent
+    (the launcher) dies before the watchdog notices."""
+    try:
+        import ctypes
+        import signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGTERM)
+    except OSError:
+        pass
+
+
 def main():
     from sparkdl_tpu.hvd import _state
 
@@ -69,6 +83,12 @@ def main():
     from sparkdl_tpu.horovod.control_plane import get_worker_client
 
     client = get_worker_client()
+    if client is not None:
+        # Fail-fast failure detection in BOTH directions: the launcher
+        # reaps dead workers; this reaps workers whose DRIVER died
+        # (even via SIGKILL) so orphans never pin chips or leases.
+        client.start_driver_watchdog()
+    _set_parent_death_signal()
     local_log = open(os.path.join(job_dir, f"rank-{rank}.log"), "a", buffering=1)
     orig_stdout, orig_stderr = sys.stdout, sys.stderr
     sys.stdout = _TeeStream("stdout", local_log, client)

@@ -267,7 +267,18 @@ class ControlPlaneClient:
         self.rank = rank
         self._sock = socket.create_connection((host, int(port)), timeout=30)
         self._sock.settimeout(None)
+        # Detect a dead driver HOST too (power-off/partition sends no
+        # FIN): aggressive TCP keepalive makes the watchdog's recv fail
+        # within ~1 minute instead of blocking forever.
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 30)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 10)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+        except (OSError, AttributeError):
+            pass  # non-Linux: keepalive is best-effort
         self._lock = threading.Lock()
+        self._closing = False
         self._native = None
         if os.environ.get("SPARKDL_TPU_NATIVE_LOGS", "1") != "0":
             try:
@@ -326,7 +337,49 @@ class ControlPlaneClient:
             self._native.flush(timeout_ms=5000)
         self._send_json(MSG_BYE, {"exit_code": exit_code})
 
+    def start_driver_watchdog(self, grace_seconds=10.0):
+        """Exit this worker when the driver disappears.
+
+        The driver never writes on the control socket, so a blocking
+        ``recv`` returns only on EOF/reset — i.e. the driver process
+        died (including SIGKILL, which the launcher's reaper can't
+        mitigate). Orphaned workers would otherwise run forever,
+        holding devices and distributed-runtime leases (observed: a
+        killed driver left gang workers pinning the TPU claim).
+        """
+
+        def watch():
+            try:
+                data = self._sock.recv(1)
+            except OSError:
+                data = b""
+            if data:
+                return  # protocol violation; driver is alive though
+            if self._closing:
+                # Our own close() raced the recv — normal teardown of a
+                # finished worker, NOT a dead driver.
+                return
+            import sys
+            import time
+
+            sys.stderr.write(
+                "sparkdl-tpu worker: driver connection lost; exiting "
+                f"in {grace_seconds:.0f}s\n"
+            )
+            sys.stderr.flush()
+            time.sleep(grace_seconds)
+            if not self._closing:
+                os._exit(83)
+
+        t = threading.Thread(
+            target=watch, name="sparkdl-tpu-driver-watchdog", daemon=True
+        )
+        t.start()
+
     def close(self):
+        # Mark BEFORE closing the socket: the driver watchdog must read
+        # this as voluntary teardown, not driver death.
+        self._closing = True
         # Detach first so racing send_log calls see None (and the
         # sender's own lock makes a send that already grabbed the
         # reference safe against the close).

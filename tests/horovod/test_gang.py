@@ -177,3 +177,76 @@ def test_alltoall_rank_divergent_splits():
 
     # rank 0 receives rank0's chunk0 ([0,1]) + rank1's chunk0 ([100])
     assert HorovodRunner(np=-2).run(main) == [0.0, 1.0, 100.0]
+
+
+@pytest.mark.gang
+def test_orphaned_workers_exit_when_driver_dies():
+    """Regression: SIGKILLing the driver must not leave gang workers
+    running (observed pinning device leases)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    driver_code = textwrap.dedent("""
+        from sparkdl import HorovodRunner
+
+        def main():
+            import time
+
+            import sparkdl_tpu.hvd as hvd
+
+            hvd.init()
+            time.sleep(300)  # long-running training
+
+        HorovodRunner(np=-2).run(main)
+    """)
+    env = dict(os.environ, SPARKDL_TPU_WORKER_PLATFORM="cpu")
+    driver = subprocess.Popen(
+        [sys.executable, "-c", driver_code], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    )
+
+    def children_of_driver():
+        # Workers are direct children of the driver process — scope to
+        # THIS test's gang; a machine-wide pgrep would count (and the
+        # cleanup would kill) other sessions' workers.
+        out = subprocess.run(
+            ["pgrep", "-P", str(driver.pid)],
+            capture_output=True, text=True,
+        ).stdout.split()
+        return [int(p) for p in out]
+
+    def alive(pids):
+        live = []
+        for p in pids:
+            try:
+                os.kill(p, 0)
+                live.append(p)
+            except ProcessLookupError:
+                pass
+        return live
+
+    try:
+        deadline = time.monotonic() + 120
+        pids = []
+        while len(pids) < 2 and time.monotonic() < deadline:
+            pids = children_of_driver()
+            time.sleep(0.5)
+        assert pids, "gang workers never started"
+
+        driver.send_signal(signal.SIGKILL)  # dies without cleanup
+        driver.wait()
+        deadline = time.monotonic() + 60
+        while alive(pids) and time.monotonic() < deadline:
+            time.sleep(1)
+        leftover = alive(pids)
+        for p in leftover:
+            os.kill(p, signal.SIGKILL)  # don't pollute the machine
+        assert not leftover, f"orphaned workers survived: {leftover}"
+    finally:
+        if driver.poll() is None:
+            driver.kill()
+            driver.wait()
