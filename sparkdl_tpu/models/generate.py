@@ -1,0 +1,76 @@
+"""Autoregressive generation for the Llama decoder: prefill + cached
+decode, both jitted once (static shapes), greedy or temperature
+sampling. Serving-side counterpart to the training path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def generate(model, params, prompt_tokens, *, max_new_tokens=32,
+             temperature=0.0, rng=None, eos_id=None):
+    """Generate continuations.
+
+    :param model: a Llama (training or decode config — a decode-mode
+        twin is derived automatically; params are shared).
+    :param prompt_tokens: (batch, prompt_len) int32.
+    :return: (batch, prompt_len + max_new_tokens) tokens.
+    """
+    from sparkdl_tpu.models.llama import Llama
+
+    prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+    b, p_len = prompt_tokens.shape
+    cfg = model.cfg
+    if p_len + max_new_tokens > cfg.max_cache_len:
+        raise ValueError(
+            f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_cache_len ({cfg.max_cache_len}); raise "
+            "LlamaConfig.max_cache_len"
+        )
+    dec_model = (
+        model if cfg.decode
+        else Llama(dataclasses.replace(cfg, decode=True))
+    )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def prefill(params, tokens):
+        logits, state = dec_model.apply(
+            {"params": params}, tokens, mutable=["cache"],
+        )
+        return logits[:, -1], state["cache"]
+
+    @jax.jit
+    def decode_step(params, cache, token, rng):
+        logits, state = dec_model.apply(
+            {"params": params, "cache": cache}, token[:, None],
+            mutable=["cache"],
+        )
+        logits = logits[:, -1]
+        rng, sub = jax.random.split(rng)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        return state["cache"], nxt.astype(jnp.int32), rng
+
+    last_logits, cache = prefill(params, prompt_tokens)
+    if temperature == 0.0:
+        token = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    else:
+        rng, sub = jax.random.split(rng)
+        token = jax.random.categorical(
+            sub, last_logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    out = [token]
+    for _ in range(max_new_tokens - 1):
+        cache, token, rng = decode_step(params, cache, token, rng)
+        out.append(token)
+        if eos_id is not None and bool((token == eos_id).all()):
+            break
+    return jnp.concatenate(
+        [prompt_tokens] + [t[:, None] for t in out], axis=1
+    )

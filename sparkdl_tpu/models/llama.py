@@ -34,6 +34,8 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     attention: str = "reference"  # "reference" (train) | "flash" (serve)
+    decode: bool = False          # KV-cache autoregressive mode
+    max_cache_len: int = 2048     # KV-cache capacity for decoding
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
@@ -107,6 +109,67 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, head_dim)
         k = k.reshape(b, s, cfg.n_kv_heads, head_dim)
         v = v.reshape(b, s, cfg.n_kv_heads, head_dim)
+
+        # Autoregressive decoding (cfg.decode): a 'cache' collection
+        # holds rotated K/V for past positions; each call appends the
+        # current step and attends over the visible prefix. Positions
+        # are derived from the cache index — the single source of
+        # truth — so RoPE and the mask can never disagree.
+        if cfg.decode:
+            if s > cfg.max_cache_len:
+                raise ValueError(
+                    f"sequence {s} exceeds max_cache_len "
+                    f"{cfg.max_cache_len}"
+                )
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(
+                    (b, cfg.max_cache_len, cfg.n_kv_heads, head_dim),
+                    k.dtype,
+                ),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(
+                    (b, cfg.max_cache_len, cfg.n_kv_heads, head_dim),
+                    v.dtype,
+                ),
+            )
+            cidx = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            start = cidx.value
+            pos_dec = start + jnp.arange(s)
+            q = apply_rope(q, cos, sin, pos_dec)
+            k = apply_rope(k, cos, sin, pos_dec)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, start, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, start, 0, 0)
+            )
+            cidx.value = start + s
+            k, v = ck.value, cv.value
+            rep = cfg.n_heads // cfg.n_kv_heads
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            # masked attention over the cache: key t visible iff
+            # t <= query position
+            q32 = q.astype(jnp.float32)
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k.astype(jnp.float32)
+            ) * (head_dim ** -0.5)
+            k_pos = jnp.arange(cfg.max_cache_len)
+            mask = k_pos[None, :] <= pos_dec[:, None]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
+            ).reshape(b, s, cfg.n_heads * head_dim)
+            return _dense(cfg, cfg.d_model, "o_proj")(o)
+
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         # GQA: repeat kv heads up to n_heads
@@ -178,9 +241,11 @@ class Llama(nn.Module):
         if positions is None:
             positions = jnp.arange(s)
         head_dim = cfg.d_model // cfg.n_heads
-        # Static RoPE table sized to the (static) sequence length;
-        # callers passing explicit positions must keep them < max(s, 2048).
-        cos, sin = rope_freqs(head_dim, max(s, 2048), cfg.rope_theta)
+        # Static RoPE table covering both training (seq s) and cached
+        # decoding (positions < max_cache_len).
+        cos, sin = rope_freqs(
+            head_dim, max(s, cfg.max_cache_len), cfg.rope_theta
+        )
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      name="embed")(tokens)
         block = Block

@@ -1,0 +1,56 @@
+"""Input pipeline utilities: keep the MXU fed.
+
+The reference has no in-tree data loader (SURVEY.md §2.1); the TPU
+framing is simple — host batches must be on-device BEFORE the step
+needs them. :func:`prefetch_to_device` double-buffers: while step N
+computes, batch N+1 is already transferring, hiding host→HBM latency
+behind compute.
+"""
+
+import collections
+import itertools
+
+
+def prefetch_to_device(iterator, size=2, sharding=None):
+    """Wrap a host-batch iterator so device transfer overlaps compute.
+
+    :param iterator: yields pytrees of numpy arrays.
+    :param size: buffer depth (2 = classic double buffering).
+    :param sharding: optional ``jax.sharding.Sharding`` (or pytree of
+        them) for multi-chip placement; default = default device.
+    """
+    import jax
+
+    queue = collections.deque()
+
+    def put(batch):
+        if sharding is None:
+            queue.append(jax.device_put(batch))
+        else:
+            queue.append(jax.device_put(batch, sharding))
+
+    for batch in itertools.islice(iterator, size):
+        put(batch)
+    it = iterator
+    while queue:
+        out = queue.popleft()
+        for batch in itertools.islice(it, 1):
+            put(batch)
+        yield out
+
+
+def batched(arrays, batch_size, *, shuffle=False, seed=0, drop_last=True):
+    """Minimal epoch iterator over a pytree of equally-long arrays."""
+    import numpy as np
+
+    import jax
+
+    leaves = jax.tree.leaves(arrays)
+    n = leaves[0].shape[0]
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    end = n - (n % batch_size) if drop_last else n
+    for start in range(0, end, batch_size):
+        sel = idx[start:start + batch_size]
+        yield jax.tree.map(lambda x: x[sel], arrays)

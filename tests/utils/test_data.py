@@ -1,0 +1,43 @@
+"""Input-pipeline tests."""
+
+import numpy as np
+
+
+def test_batched_and_prefetch_roundtrip():
+    import jax
+
+    from sparkdl_tpu.utils.data import batched, prefetch_to_device
+
+    data = {
+        "x": np.arange(20, dtype=np.float32).reshape(10, 2),
+        "y": np.arange(10, dtype=np.int32),
+    }
+    batches = list(prefetch_to_device(batched(data, 4), size=2))
+    assert len(batches) == 2  # drop_last
+    assert isinstance(batches[0]["x"], jax.Array)
+    np.testing.assert_allclose(
+        np.asarray(batches[0]["x"]), data["x"][:4]
+    )
+    # shuffle is deterministic per seed and a permutation
+    all_y = np.concatenate([
+        np.asarray(b["y"]) for b in
+        prefetch_to_device(batched(data, 5, shuffle=True, seed=1))
+    ])
+    assert sorted(all_y.tolist()) == list(range(10))
+
+
+def test_prefetch_with_sharding():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    from sparkdl_tpu.utils.data import batched, prefetch_to_device
+
+    mesh = make_mesh(MeshSpec(data=8))
+    sharding = NamedSharding(mesh, P(("data", "fsdp")))
+    data = {"x": np.ones((16, 4), np.float32)}
+    (batch,) = prefetch_to_device(
+        batched(data, 16), sharding=sharding
+    )
+    assert len(batch["x"].sharding.device_set) == 8
