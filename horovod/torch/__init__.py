@@ -101,9 +101,11 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
     optimizer.load_state_dict(synced)
 
 
-def _fused_allreduce_grads(params, op):
+def _fused_allreduce_grads(params, op, compression=None):
     """Flatten all grads per dtype into one buffer → one collective per
-    dtype → scatter back (tensor-fusion analogue)."""
+    dtype → scatter back (tensor-fusion analogue). With fp16
+    compression the wire buffer is half width (reference Horovod's
+    gradient-compression knob)."""
     by_dtype = {}
     for p in params:
         if p.grad is not None:
@@ -111,7 +113,16 @@ def _fused_allreduce_grads(params, op):
     for dtype, ps in by_dtype.items():
         flats = [p.grad.detach().cpu().numpy().ravel() for p in ps]
         buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
-        out = engine().reduce(np.ascontiguousarray(buf), op)
+        buf = np.ascontiguousarray(buf)
+        ctx = None
+        if compression is not None:
+            buf, ctx = compression.compress(buf)
+            buf = np.ascontiguousarray(np.asarray(buf))
+        out = engine().reduce(buf, op)
+        if compression is not None:
+            out = np.asarray(compression.decompress(out, ctx))
+        # decompress restores the group dtype, and Tensor.copy_ casts
+        # if needed — no per-param host round-trips here.
         offset = 0
         with torch.no_grad():
             for p in ps:
@@ -142,19 +153,23 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     update. The returned object is still an instance of the original
     optimizer class, so lr_schedulers and checkpoint code keep
     working."""
-    del named_parameters, compression, backward_passes_per_step, kwargs
+    del named_parameters, backward_passes_per_step, kwargs
     kind = _resolve_op(average, op)
     cls = optimizer.__class__
 
     class _DistributedOptimizer(cls):
+        def _do_sync(self):
+            params = [p for g in self.param_groups for p in g["params"]]
+            _fused_allreduce_grads(
+                params, self._hvd_op,
+                getattr(self, "_hvd_compression", None),
+            )
+
         def _hvd_sync(self):
             if _state.state().size > 1 and not getattr(
                 self, "_hvd_skip_sync", False
             ):
-                params = [
-                    p for g in self.param_groups for p in g["params"]
-                ]
-                _fused_allreduce_grads(params, self._hvd_op)
+                self._do_sync()
 
         def step(self, closure=None):
             _state.require_initialized()
@@ -175,8 +190,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
             return super().step(synced_closure)
 
         def synchronize(self):
-            params = [p for g in self.param_groups for p in g["params"]]
-            _fused_allreduce_grads(params, self._hvd_op)
+            self._do_sync()
 
         def skip_synchronize(self):
             return _SkipSync(self)
@@ -184,6 +198,9 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     _DistributedOptimizer.__name__ = "Distributed" + cls.__name__
     optimizer.__class__ = _DistributedOptimizer
     optimizer._hvd_op = kind
+    optimizer._hvd_compression = (
+        None if compression is Compression.none else compression
+    )
     optimizer._hvd_skip_sync = False
     return optimizer
 

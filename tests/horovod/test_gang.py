@@ -250,3 +250,50 @@ def test_orphaned_workers_exit_when_driver_dies():
         if driver.poll() is None:
             driver.kill()
             driver.wait()
+
+
+@pytest.mark.gang
+def test_np_zero_uses_all_slots(monkeypatch):
+    """np=0 (deprecated) resolves to all task slots (reference
+    README.md:57-61)."""
+    monkeypatch.setenv("SPARKDL_TPU_NUM_SLOTS", "2")
+
+    def main():
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        return hvd.size()
+
+    assert HorovodRunner(np=0).run(main) == 2
+
+
+@pytest.mark.gang
+def test_torch_fp16_compressed_allreduce():
+    """Compression.fp16 halves the wire buffer; training still syncs."""
+
+    def main():
+        import torch
+
+        import horovod.torch as hvd
+
+        hvd.init()
+        torch.manual_seed(99 + hvd.rank())
+        model = torch.nn.Linear(4, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            compression=hvd.Compression.fp16,
+        )
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        x = torch.full((4, 4), float(hvd.rank() + 1))
+        ((model(x) - 1.0) ** 2).mean().backward()
+        opt.step()
+        import numpy as np
+
+        flat = np.concatenate(
+            [p.detach().numpy().ravel() for p in model.parameters()]
+        )
+        gathered = hvd.allgather(flat[None, :])
+        return float(np.abs(gathered[0] - gathered[1]).max())
+
+    # fp16 wire precision: ranks stay in lockstep (identical rounding)
+    assert HorovodRunner(np=-2).run(main) == 0.0
