@@ -1,3 +1,8 @@
 """TPU kernels (pallas) and kernel-dispatching ops."""
 
 from sparkdl_tpu.ops.attention import flash_attention  # noqa: F401
+from sparkdl_tpu.ops.pallas.quantized_matmul import (  # noqa: F401
+    quantize_int8,
+    quantize_params,
+    quantized_matmul,
+)

@@ -1,0 +1,70 @@
+"""int8 weight-only matmul kernel tests (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.ops.pallas.quantized_matmul import (
+    quantize_int8,
+    quantized_matmul,
+    quantize_params,
+)
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.RandomState(0)
+    w = rng.randn(64, 32).astype(np.float32)
+    w_q, s = quantize_int8(w)
+    assert w_q.dtype == np.int8 and s.shape == (32,)
+    deq = w_q.astype(np.float32) * s[None, :]
+    # symmetric per-channel int8: error <= scale/2 per element
+    assert (np.abs(deq - w) <= s[None, :] / 2 + 1e-7).all()
+
+
+@pytest.mark.parametrize("m", [128, 200])
+def test_kernel_matches_dequant_matmul(m):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(m, 64), jnp.float32)
+    w = rng.randn(64, 128).astype(np.float32)
+    w_q, s = quantize_int8(w)
+    out = quantized_matmul(
+        x, jnp.asarray(w_q), jnp.asarray(s), interpret=True
+    )
+    ref = np.asarray(x) @ (w_q.astype(np.float32) * s[None, :])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3, rtol=1e-4)
+
+
+def test_quantized_accuracy_vs_full_precision():
+    """End-to-end error of the quantized matmul vs the fp32 weights is
+    small relative to output magnitude."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(128, 256), jnp.float32)
+    w = (rng.randn(256, 128) * 0.05).astype(np.float32)
+    w_q, s = quantize_int8(w)
+    out_q = np.asarray(quantized_matmul(
+        x, jnp.asarray(w_q), jnp.asarray(s), interpret=True
+    ))
+    out_f = np.asarray(x) @ w
+    rel = np.abs(out_q - out_f).mean() / (np.abs(out_f).mean() + 1e-9)
+    assert rel < 0.02, rel
+
+
+def test_quantize_params_tree():
+    import jax
+
+    from sparkdl_tpu.models import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    qparams, saved = quantize_params(params)
+    assert saved > 0
+    flat = jax.tree_util.tree_flatten_with_path(qparams)[0]
+    names = ["/".join(str(getattr(p, "key", p)) for p in path)
+             for path, _ in flat]
+    assert any("kernel_q" in n for n in names)
+    assert any("kernel_scale" in n for n in names)
+    # norms and embeddings untouched
+    assert any(n.endswith("embed/embedding") for n in names)
