@@ -6,8 +6,6 @@ canonical example trains tf.keras under HorovodRunner, reference
 ``README.md:33-54``); with it, that main runs unmodified on TPU.
 """
 
-import tensorflow as tf
-
 from horovod.tensorflow import (  # noqa: F401
     Average,
     Compression,

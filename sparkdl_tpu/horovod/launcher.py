@@ -34,6 +34,11 @@ import time
 
 logger = logging.getLogger("HorovodRunner")
 
+
+class SlotExhaustionError(RuntimeError):
+    """np exceeds available task slots (reference runner_base.py:56-58).
+    Never retried — more restarts cannot create slots."""
+
 START_TIMEOUT_ENV = "SPARKDL_TPU_START_TIMEOUT"
 NUM_SLOTS_ENV = "SPARKDL_TPU_NUM_SLOTS"
 WORKER_PLATFORM_ENV = "SPARKDL_TPU_WORKER_PLATFORM"
@@ -95,7 +100,7 @@ def _resolve_num_workers(np_arg):
     slots = available_slots()
     if np_arg > slots:
         # Fail fast (reference runner_base.py:56-58).
-        raise RuntimeError(
+        raise SlotExhaustionError(
             f"HorovodRunner requested np={np_arg} task slots but only "
             f"{slots} are available; the job fails fast rather than wait "
             "(set SPARKDL_TPU_NUM_SLOTS to override slot discovery)."
@@ -149,12 +154,40 @@ def _tail(path, n=40):
 def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     """Launch a gang of workers and return rank 0's result.
 
+    Recovery model (SURVEY.md §5.3): gangs are fail-fast, not elastic —
+    the recovery story is relaunch. Set ``SPARKDL_TPU_MAX_RESTARTS=N``
+    to retry a failed gang up to N times (fresh job dir, fresh
+    rendezvous) before surfacing the error; slot-exhaustion failures
+    are never retried (they cannot self-heal).
+
     :param per_rank_kwargs: optional list (len = gang size) of dicts
         merged into ``kwargs`` for each rank and serialized into that
         rank's own payload — so rank-private data (e.g. a dataset
         shard) is shipped only to its worker instead of to the whole
         gang.
     """
+    max_restarts = int(os.environ.get("SPARKDL_TPU_MAX_RESTARTS", "0"))
+    attempt = 0
+    while True:
+        try:
+            return _launch_gang_once(
+                np, main, kwargs, driver_log_verbosity, per_rank_kwargs
+            )
+        except SlotExhaustionError:
+            raise  # typed, never retryable
+        except RuntimeError as e:
+            if attempt >= max_restarts:
+                raise
+            attempt += 1
+            first_line = (str(e).splitlines() or ["<no message>"])[0]
+            logger.warning(
+                "HorovodRunner gang failed (attempt %d/%d); relaunching: %s",
+                attempt, max_restarts, first_line,
+            )
+
+
+def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
+                      per_rank_kwargs=None):
     import cloudpickle
 
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer

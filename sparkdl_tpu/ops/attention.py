@@ -10,25 +10,9 @@ kernel roadmap; the forward kernel is what serving latency sees).
 import functools
 
 import jax
-import jax.numpy as jnp
 
+from sparkdl_tpu.ops._dispatch import block_for, pad_to as _pad_to, use_pallas as _use_pallas
 from sparkdl_tpu.parallel.ring_attention import attention_reference
-
-
-def _use_pallas():
-    try:
-        return jax.default_backend() == "tpu"
-    except RuntimeError:
-        return False
-
-
-def _pad_to(x, multiple, axis):
-    pad = (-x.shape[axis]) % multiple
-    if pad == 0:
-        return x, 0
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths), pad
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret):
@@ -39,7 +23,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     s = qt.shape[2]
-    block = 128 if s >= 128 else max(8, s)
+    block = block_for(s)
     qt, pad = _pad_to(qt, block, 2)
     kt, _ = _pad_to(kt, block, 2)
     vt, _ = _pad_to(vt, block, 2)

@@ -67,26 +67,24 @@ def quantized_matmul_pallas(x, w_q, scales, *, block_m=128, block_n=128,
 
 def quantized_matmul(x, w_q, scales, *, interpret=None):
     """Dispatch: pallas kernel on TPU (or interpret for tests), XLA
-    dequant-matmul elsewhere."""
+    dequant-matmul elsewhere. M and N are padded to tile multiples and
+    sliced back."""
+    from sparkdl_tpu.ops._dispatch import block_for, pad_to, use_pallas
+
     if interpret is None:
-        try:
-            on_tpu = jax.default_backend() == "tpu"
-        except RuntimeError:
-            on_tpu = False
-        if not on_tpu:
+        if not use_pallas():
             w = w_q.astype(jnp.float32) * scales[None, :]
             return (x.astype(jnp.float32) @ w).astype(x.dtype)
         interpret = False
-    # pad M to the tile if needed (N, K are weight-static)
-    m = x.shape[0]
-    bm = 128 if m >= 128 else max(8, m)
-    pad = (-m) % bm
-    if pad:
-        x = jnp.pad(x, ((0, pad), (0, 0)))
+    m, n = x.shape[0], w_q.shape[1]
+    bm, bn = block_for(m), block_for(n, floor=128)
+    x, pad_m = pad_to(x, bm, 0)
+    w_q, pad_n = pad_to(w_q, bn, 1)
+    scales, _ = pad_to(scales, bn, 0)
     out = quantized_matmul_pallas(
-        x, w_q, scales, block_m=bm, interpret=interpret
+        x, w_q, scales, block_m=bm, block_n=bn, interpret=interpret
     )
-    return out[:m] if pad else out
+    return out[:m, :n] if (pad_m or pad_n) else out
 
 
 def quantize_params(params, targets=("gate_proj", "up_proj", "down_proj",
@@ -101,9 +99,13 @@ def quantize_params(params, targets=("gate_proj", "up_proj", "down_proj",
         if isinstance(node, dict):
             if ("kernel" in node and any(t in name for t in targets)
                     and getattr(node["kernel"], "ndim", 0) == 2):
-                w = np.asarray(node["kernel"], np.float32)
-                w_q, s = quantize_int8(w)
-                saved[0] += w.nbytes - w_q.nbytes - s.nbytes
+                orig = node["kernel"]
+                w_q, s = quantize_int8(np.asarray(orig, np.float32))
+                # savings accounted against the ORIGINAL dtype (bf16
+                # kernels are 2 bytes/elt, not 4)
+                saved[0] += (
+                    np.asarray(orig).nbytes - w_q.nbytes - s.nbytes
+                )
                 out = dict(node)
                 out["kernel_q"] = w_q
                 out["kernel_scale"] = s
@@ -113,3 +115,26 @@ def quantize_params(params, targets=("gate_proj", "up_proj", "down_proj",
         return node
 
     return walk(params), saved[0]
+
+
+def dequantize_params(qparams, dtype=jnp.bfloat16):
+    """Reconstruct an apply-compatible param tree from
+    :func:`quantize_params` output: every (kernel_q, kernel_scale) pair
+    becomes a dense ``kernel`` again. Use this to run a standard
+    ``model.apply`` off a quantized checkpoint; serving stacks that
+    call :func:`quantized_matmul` directly can keep the int8 leaves."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "kernel_q" in node:
+                out = {k: v for k, v in node.items()
+                       if k not in ("kernel_q", "kernel_scale")}
+                out["kernel"] = (
+                    jnp.asarray(node["kernel_q"], jnp.float32)
+                    * jnp.asarray(node["kernel_scale"])[None, :]
+                ).astype(dtype)
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)

@@ -297,3 +297,40 @@ def test_torch_fp16_compressed_allreduce():
 
     # fp16 wire precision: ranks stay in lockstep (identical rounding)
     assert HorovodRunner(np=-2).run(main) == 0.0
+
+
+@pytest.mark.gang
+def test_gang_restart_on_failure(monkeypatch, tmp_path):
+    """SPARKDL_TPU_MAX_RESTARTS relaunches a failed gang (SURVEY.md
+    §5.3: relaunch IS the recovery story)."""
+    monkeypatch.setenv("SPARKDL_TPU_MAX_RESTARTS", "2")
+    marker = tmp_path / "attempts"
+
+    def flaky_main(marker_path):
+        import os
+
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        if hvd.rank() == 0:
+            with open(marker_path, "a") as fh:
+                fh.write("x")
+            if os.path.getsize(marker_path) < 2:
+                raise RuntimeError("transient failure on first attempt")
+        return "recovered"
+
+    result = HorovodRunner(np=-2).run(flaky_main, marker_path=str(marker))
+    assert result == "recovered"
+    assert marker.read_text() == "xx"  # failed once, succeeded once
+
+
+@pytest.mark.gang
+def test_slot_exhaustion_not_retried(monkeypatch):
+    monkeypatch.setenv("SPARKDL_TPU_MAX_RESTARTS", "5")
+    monkeypatch.setenv("SPARKDL_TPU_NUM_SLOTS", "1")
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="fails fast"):
+        HorovodRunner(np=8).run(lambda: None)
+    assert time.monotonic() - t0 < 30  # no retry loop
