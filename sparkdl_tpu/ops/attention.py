@@ -1,10 +1,11 @@
-"""Attention dispatch: pallas flash kernel on TPU, XLA reference
+"""Attention dispatch: pallas flash kernels on TPU, XLA reference
 elsewhere, with padding and layout handling.
 
 Public shape convention matches the models: (batch, seq, heads,
-head_dim). Gradients flow through a custom_vjp whose backward
-recomputes via the XLA reference path (fused backward kernel is on the
-kernel roadmap; the forward kernel is what serving latency sees).
+head_dim). Both directions are fused pallas kernels: the forward saves
+only the per-row logsumexp, and the custom_vjp backward recomputes
+probabilities tile-by-tile (dq kernel + dk/dv kernel) — O(S·D) memory
+for training end to end.
 """
 
 import functools
@@ -15,64 +16,75 @@ from sparkdl_tpu.ops._dispatch import block_for, pad_to as _pad_to, use_pallas a
 from sparkdl_tpu.parallel.ring_attention import attention_reference
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
+# custom_vjp over the PADDED (B, H, S, D) core: both forward and
+# backward are fused pallas kernels; padding/layout transforms sit
+# outside and differentiate through standard XLA transposes.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, scale, block, interpret):
     from sparkdl_tpu.ops.pallas.flash_attention import flash_attention_bhsd
 
-    # (B, S, H, D) -> (B, H, S, D); pad S to the 128 tile
+    return flash_attention_bhsd(
+        q, k, v, causal=causal, scale=scale, bq=block, bk=block,
+        interpret=interpret,
+    )
+
+
+def _flash_core_fwd(q, k, v, causal, scale, block, interpret):
+    from sparkdl_tpu.ops.pallas.flash_attention import flash_attention_bhsd
+
+    o, lse = flash_attention_bhsd(
+        q, k, v, causal=causal, scale=scale, bq=block, bk=block,
+        interpret=interpret, return_lse=True,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(causal, scale, block, interpret, res, do):
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.ops.pallas.flash_attention import (
+        flash_attention_bwd_bhsd,
+    )
+
+    q, k, v, o, lse = res
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+    dq, dk, dv = flash_attention_bwd_bhsd(
+        q, k, v, do, lse, delta, causal=causal, scale=scale,
+        bq=block, bk=block, interpret=interpret,
+    )
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None):
+    """Fused attention on (batch, seq, heads, head_dim) tensors —
+    pallas forward AND backward on TPU (or ``interpret=True`` for
+    tests); XLA reference elsewhere.
+    """
+    if interpret is None:
+        if not _use_pallas():
+            return attention_reference(q, k, v, causal=causal, scale=scale)
+        interpret = False
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     s = qt.shape[2]
     block = block_for(s)
     qt, pad = _pad_to(qt, block, 2)
-    kt, _ = _pad_to(kt, block, 2)
-    vt, _ = _pad_to(vt, block, 2)
     if pad and not causal:
         # padded keys must not receive attention weight: causal masking
-        # already excludes them for causal=True (queries come first);
-        # for bidirectional attention fall back to the reference path.
+        # excludes them (queries come first); for bidirectional
+        # attention fall back to the reference path.
         return attention_reference(q, k, v, causal=False, scale=scale)
-    out = flash_attention_bhsd(
-        qt, kt, vt, causal=causal, scale=scale, bq=block, bk=block,
-        interpret=interpret,
-    )
+    kt, _ = _pad_to(kt, block, 2)
+    vt, _ = _pad_to(vt, block, 2)
+    out = _flash_core(qt, kt, vt, causal, scale, block, interpret)
     if pad:
-        out = out[:, :, : s, :]
+        out = out[:, :, :s, :]
     return out.transpose(0, 2, 1, 3)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, interpret):
-    return _flash_fwd(q, k, v, causal, scale, interpret)
-
-
-def _flash_vjp_fwd(q, k, v, causal, scale, interpret):
-    return _flash_fwd(q, k, v, causal, scale, interpret), (q, k, v)
-
-
-def _flash_vjp_bwd(causal, scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=causal, scale=scale
-        ),
-        q, k, v,
-    )
-    return vjp(g)
-
-
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None):
-    """Fused attention on (batch, seq, heads, head_dim) tensors.
-
-    Uses the pallas TPU kernel when running on TPU (or when
-    ``interpret=True`` for testing on CPU); otherwise the XLA reference
-    implementation.
-    """
-    if interpret is None:
-        if not _use_pallas():
-            return attention_reference(q, k, v, causal=causal, scale=scale)
-        interpret = False
-    return _flash(q, k, v, causal, scale, interpret)

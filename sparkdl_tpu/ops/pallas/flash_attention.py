@@ -15,9 +15,12 @@ pallas TPU playbook):
   bf16 inputs hit the MXU natively.
 
 The public wrapper pads S to the tile size and handles (B, S, H, D)
-layout; backward currently recomputes through the XLA reference path
-via custom_vjp (a fused backward kernel is the next kernel on the
-roadmap — forward is where inference/serving time goes).
+layout. The BACKWARD is fused too: the forward saves only the per-row
+logsumexp (B, H, S); backward recomputes attention probabilities
+tile-by-tile from (q, k, lse) and accumulates dq (one kernel, grid over
+q tiles) and dk/dv (one kernel, grid over kv tiles) — standard
+flash-attention backward, O(S·D) memory end to end, causal-pruned in
+both directions.
 """
 
 import functools
@@ -29,12 +32,22 @@ import numpy as np
 NEG_INF = -1e30
 
 
-def _make_kernel(bq, bk, seq_len, causal, scale):
+def _causal_keep(q_start, k_start, bq, bk):
+    """Block-local causal visibility mask (q_pos >= k_pos), shared by
+    the forward and both backward kernels so masking semantics can
+    never diverge between them."""
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+
+def _make_kernel(bq, bk, seq_len, causal, scale, with_lse=False):
     from jax.experimental import pallas as pl
 
     n_k_blocks = seq_len // bk
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse):
         qi = pl.program_id(2)
         q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
         d = q.shape[-1]
@@ -48,13 +61,9 @@ def _make_kernel(bq, bk, seq_len, causal, scale):
                 preferred_element_type=jnp.float32,
             )                                                 # (bq, bk)
             if causal:
-                q_pos = qi * bq + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
+                s_ij = jnp.where(
+                    _causal_keep(qi * bq, j * bk, bq, bk), s_ij, NEG_INF
                 )
-                k_pos = j * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                s_ij = jnp.where(q_pos >= k_pos, s_ij, NEG_INF)
             m_blk = jnp.max(s_ij, axis=-1)
             m_new = jnp.maximum(m, m_blk)
             p = jnp.exp(s_ij - m_new[:, None])
@@ -81,16 +90,20 @@ def _make_kernel(bq, bk, seq_len, causal, scale):
         m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
         out = acc / jnp.maximum(l, 1e-30)[:, None]
         o_ref[0, 0] = out.astype(o_ref.dtype)
+        if with_lse:
+            # logsumexp per row: softmax probs are exp(s - lse) in bwd
+            maybe_lse[0][0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
     return kernel
 
 
 def flash_attention_bhsd(q, k, v, *, causal=True, scale=None, bq=128,
-                         bk=128, interpret=False):
+                         bk=128, interpret=False, return_lse=False):
     """Flash attention on (batch, heads, seq, head_dim) arrays.
 
     seq must be divisible by the block sizes (the public wrapper in
-    :mod:`sparkdl_tpu.ops.attention` pads).
+    :mod:`sparkdl_tpu.ops.attention` pads). With ``return_lse`` also
+    returns the per-row logsumexp (B, H, S) for the fused backward.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -102,18 +115,171 @@ def flash_attention_bhsd(q, k, v, *, causal=True, scale=None, bq=128,
     if s % bq or s % bk:
         raise ValueError(f"seq {s} must be divisible by bq={bq}, bk={bk}")
 
-    kernel = _make_kernel(bq, bk, s, causal, scale)
+    kernel = _make_kernel(bq, bk, s, causal, scale, with_lse=return_lse)
     grid = (b, h, s // bq)
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
     kv_spec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    return pl.pallas_call(
+    lse_spec = pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i))
+    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if return_lse:
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        )
+    out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=q_spec,
+        out_specs=(q_spec, lse_spec) if return_lse else q_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel"),
         ),
         interpret=interpret,
     )(q, k, v)
+    return out
+
+
+def _make_dq_kernel(bq, bk, seq_len, causal, scale):
+    from jax.experimental import pallas as pl
+
+    n_k_blocks = seq_len // bk
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        qi = pl.program_id(2)
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                                 # (bq,)
+        delta = delta_ref[0, 0]                             # (bq,)
+
+        def body(j, dq):
+            kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            s_ij = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            p = jnp.exp(s_ij - lse[:, None])
+            if causal:
+                p = jnp.where(
+                    _causal_keep(qi * bq, j * bk, bq, bk), p, 0.0
+                )
+            dp = jax.lax.dot_general(
+                do, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None]) * scale
+            return dq + jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        upper = (
+            jnp.minimum((qi * bq + bq + bk - 1) // bk, n_k_blocks)
+            if causal else n_k_blocks
+        )
+        dq = jax.lax.fori_loop(
+            0, upper, body, jnp.zeros(q.shape, jnp.float32)
+        )
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_kernel(bq, bk, seq_len, causal, scale):
+    from jax.experimental import pallas as pl
+
+    n_q_blocks = seq_len // bq
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dk_ref, dv_ref):
+        ki = pl.program_id(2)
+        kb = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
+        vb = v_ref[0, 0].astype(jnp.float32)
+
+        def body(i, carry):
+            dk, dv = carry
+            qb = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            dob = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            lse = lse_ref[0, 0, pl.ds(i * bq, bq)]
+            delta = delta_ref[0, 0, pl.ds(i * bq, bq)]
+            s_ij = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                       # (bq, bk)
+            p = jnp.exp(s_ij - lse[:, None])
+            if causal:
+                p = jnp.where(
+                    _causal_keep(i * bq, ki * bk, bq, bk), p, 0.0
+                )
+            dv = dv + jax.lax.dot_general(
+                p, dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None]) * scale
+            return dk + jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ), dv
+
+        # causal: only q blocks at or after this kv block contribute
+        lower = (ki * bk) // bq if causal else 0
+        dk0 = jnp.zeros(kb.shape, jnp.float32)
+        dv0 = jnp.zeros(vb.shape, jnp.float32)
+        dk, dv = jax.lax.fori_loop(lower, n_q_blocks, body, (dk0, dv0))
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    return kernel
+
+
+def flash_attention_bwd_bhsd(q, k, v, do, lse, delta, *, causal=True,
+                             scale=None, bq=128, bk=128, interpret=False):
+    """Fused backward: (dq, dk, dv) from saved (q, k, v, lse) and the
+    output-gradient rowsum delta = sum(do * o, -1)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    scale = scale or (d ** -0.5)
+    bq = min(bq, s)
+    bk = min(bk, s)
+    if s % bq or s % bk:
+        raise ValueError(f"seq {s} must be divisible by bq={bq}, bk={bk}")
+
+    q_tile = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
+    k_tile = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, i: (bi, hi, i, 0))
+    full_s = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    vec_q = pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i))
+    vec_full = pl.BlockSpec((1, 1, s), lambda bi, hi, i: (bi, hi, 0))
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel"),
+    )
+
+    dq = pl.pallas_call(
+        _make_dq_kernel(bq, bk, s, causal, scale),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(b, h, s // bq),
+        in_specs=[q_tile, full_s, full_s, q_tile, vec_q, vec_q],
+        out_specs=q_tile,
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(bq, bk, s, causal, scale),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        grid=(b, h, s // bk),
+        in_specs=[full_s, k_tile, k_tile, full_s, vec_full, vec_full],
+        out_specs=(k_tile, k_tile),
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
