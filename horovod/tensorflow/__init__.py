@@ -8,6 +8,7 @@ ranked #1 in SURVEY.md §7 (TF↔JAX device coexistence); the zero-copy
 dlpack fast path is tracked on the roadmap.
 """
 
+import numpy as np
 import tensorflow as tf
 
 from sparkdl_tpu.hvd import (  # noqa: F401
@@ -70,6 +71,35 @@ def allreduce(tensor, average=None, name=None, op=None, **kwargs):
     return _numpy_collective(tensor, lambda x: engine().reduce(x, kind))
 
 
+def grouped_allreduce(tensors, average=None, name=None, op=None):
+    """Allreduce a list of TF tensors in ONE host crossing: a single
+    py_function (or eager call) delegates to the core
+    :func:`sparkdl_tpu.hvd.grouped_allreduce`, which fuses per dtype —
+    graph-mode training pays one eager hop per step instead of one per
+    gradient."""
+    del name
+    _state.require_initialized()
+    kind = _resolve_op(average, op)
+    tensors = [_densify(tf.convert_to_tensor(t)) for t in tensors]
+    if not tensors:
+        return []
+
+    def _np_grouped(*ts):
+        from sparkdl_tpu.hvd import grouped_allreduce as core_grouped
+
+        outs = core_grouped([t.numpy() for t in ts], op=kind)
+        return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
+
+    if tf.executing_eagerly() and all(
+        isinstance(t, tf.__internal__.EagerTensor) for t in tensors
+    ):
+        return _np_grouped(*tensors)
+    outs = tf.py_function(_np_grouped, tensors, [t.dtype for t in tensors])
+    for o, t in zip(outs, tensors):
+        o.set_shape(t.shape)
+    return list(outs)
+
+
 def broadcast(tensor, root_rank, name=None):
     del name
     _state.require_initialized()
@@ -120,17 +150,23 @@ class DistributedGradientTape:
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
         # sources may be a single tensor, a list, or any nested
-        # structure — mirror its shape, like tf.GradientTape does.
-        return tf.nest.map_structure(
-            lambda g: None if g is None else allreduce(g, op=self._op),
-            grads,
-        )
+        # structure — mirror its shape, like tf.GradientTape does,
+        # but reduce ALL grads in one grouped host crossing.
+        flat = tf.nest.flatten(grads)
+        live = [(i, g) for i, g in enumerate(flat) if g is not None]
+        if live:
+            reduced = grouped_allreduce(
+                [g for _, g in live], op=self._op
+            )
+            for (i, _), r in zip(live, reduced):
+                flat[i] = r
+        return tf.nest.pack_sequence_as(grads, flat)
 
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
-    "local_size", "cross_rank", "cross_size", "allreduce", "allgather",
-    "broadcast", "broadcast_object", "broadcast_variables", "barrier",
-    "alltoall", "Average", "Sum", "Min", "Max", "Compression",
-    "DistributedGradientTape",
+    "local_size", "cross_rank", "cross_size", "allreduce",
+    "grouped_allreduce", "allgather", "broadcast", "broadcast_object",
+    "broadcast_variables", "barrier", "alltoall", "Average", "Sum",
+    "Min", "Max", "Compression", "DistributedGradientTape",
 ]

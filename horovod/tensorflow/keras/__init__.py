@@ -45,11 +45,18 @@ def DistributedOptimizer(optimizer, name=None, compression=None,
 
         def apply_gradients(self, grads_and_vars, **kw):
             gv = list(grads_and_vars)
-            reduced = [
-                (None if g is None else allreduce(g, op=self._hvd_op), v)
-                for g, v in gv
-            ]
-            return super().apply_gradients(reduced, **kw)
+            live = [(i, g) for i, (g, _) in enumerate(gv) if g is not None]
+            if live:
+                from horovod.tensorflow import grouped_allreduce
+
+                # one host crossing for ALL gradients per step
+                reduced = grouped_allreduce(
+                    [g for _, g in live], op=self._hvd_op
+                )
+                gv = list(gv)
+                for (i, _), r in zip(live, reduced):
+                    gv[i] = (r, gv[i][1])
+            return super().apply_gradients(gv, **kw)
 
     _DistributedOptimizer.__name__ = "Distributed" + cls.__name__
     optimizer.__class__ = _DistributedOptimizer

@@ -157,3 +157,31 @@ def test_lora_merge_equivalence():
     np.testing.assert_allclose(
         np.asarray(out_lora), np.asarray(out_merged), atol=1e-5
     )
+
+
+def test_bert_params_shard_with_transformer_rules():
+    """BERT module names align with the tensor-parallel sharding rules
+    (q_proj/fc1 column-parallel, o_proj/fc2 row-parallel)."""
+    from sparkdl_tpu.models import Bert, BertConfig
+    from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+    from sparkdl_tpu.parallel.sharding import (
+        TRANSFORMER_RULES,
+        param_sharding,
+    )
+
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+    model = Bert(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    shardings = param_sharding(params, TRANSFORMER_RULES, mesh)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    by_name = {
+        "/".join(str(getattr(p, "key", p)) for p in path): s
+        for path, s in flat
+    }
+    fc1 = next(v for k, v in by_name.items() if "fc1/kernel" in k)
+    assert "model" in str(fc1.spec)
+    ln = next(v for k, v in by_name.items() if "attn_norm/scale" in k)
+    assert ln.spec == jax.sharding.PartitionSpec()
