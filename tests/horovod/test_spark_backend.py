@@ -1,0 +1,74 @@
+"""Spark-backend driver-side logic, tested against a faked pyspark
+(the real barrier path needs a cluster; the decision logic and
+fail-fast contract are testable anywhere)."""
+
+import sys
+import types
+
+import pytest
+
+
+@pytest.fixture
+def fake_pyspark(monkeypatch):
+    """Install minimal pyspark modules so spark_backend imports."""
+    pyspark = types.ModuleType("pyspark")
+    sql = types.ModuleType("pyspark.sql")
+
+    class FakeBarrierTaskContext:
+        @staticmethod
+        def get():
+            raise RuntimeError("not in a barrier task")
+
+    class FakeSparkSession:
+        _active = None
+
+        @staticmethod
+        def getActiveSession():
+            return FakeSparkSession._active
+
+    sql.SparkSession = FakeSparkSession
+    pyspark.BarrierTaskContext = FakeBarrierTaskContext
+    pyspark.sql = sql
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", sql)
+    # force re-import of the backend against the fake
+    sys.modules.pop("sparkdl_tpu.horovod.spark_backend", None)
+    yield FakeSparkSession
+    sys.modules.pop("sparkdl_tpu.horovod.spark_backend", None)
+
+
+def test_no_active_session_falls_back(fake_pyspark):
+    from sparkdl_tpu.horovod.spark_backend import maybe_launch_on_spark
+
+    assert maybe_launch_on_spark(2, lambda: None, {}, "all") is None
+
+
+def test_slot_check_fails_fast(fake_pyspark):
+    from sparkdl_tpu.horovod.spark_backend import maybe_launch_on_spark
+
+    class FakeContext:
+        defaultParallelism = 2
+
+    class FakeSession:
+        sparkContext = FakeContext()
+
+    fake_pyspark._active = FakeSession()
+    try:
+        with pytest.raises(RuntimeError, match="failing fast"):
+            maybe_launch_on_spark(8, lambda: None, {}, "all")
+    finally:
+        fake_pyspark._active = None
+
+
+def test_launcher_falls_back_without_pyspark():
+    """Without pyspark installed at all, cluster mode uses the local
+    gang (exercised constantly by the np>0 tests); the import gate
+    must swallow only ImportError."""
+    import importlib
+
+    assert importlib.util.find_spec("pyspark") is None
+    from sparkdl_tpu.horovod import launcher
+
+    # _resolve_num_workers works and launch path exists
+    n, mode = launcher._resolve_num_workers(-2)
+    assert (n, mode) == (2, "local")
