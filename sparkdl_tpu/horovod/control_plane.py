@@ -221,6 +221,16 @@ class ControlPlaneServer:
                 self._ready_cond.wait(remaining)
         return True
 
+    def wait_drained(self, timeout=5.0):
+        """Join connection handlers so every frame already on the wire
+        is processed. Workers' sockets hit EOF when their processes
+        exit, and TCP delivers all buffered bytes before EOF — so once
+        the handler threads finish, no log line can arrive late (the
+        tail-of-job guarantee behind the 'all'-verbosity contract)."""
+        deadline = time.monotonic() + timeout
+        for t in list(self._threads):
+            t.join(max(0.0, deadline - time.monotonic()))
+
     def ready_count(self):
         with self._lock:
             return len(self._ready)

@@ -248,9 +248,14 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     except Exception:  # pragma: no cover - never block launch on this
         pass
 
+    # Local subprocess mode streams training stdout/stderr to the
+    # driver unconditionally (reference README.md:44-47: "Training
+    # stdout and stderr messages go to the notebook cell output");
+    # cluster mode honors driver_log_verbosity (runner_base.py:62-72).
+    effective_verbosity = "all" if mode == "local" else driver_log_verbosity
     server = ControlPlaneServer(
         num_workers,
-        verbosity=driver_log_verbosity,
+        verbosity=effective_verbosity,
         log_path=os.path.join(job_dir, "job.log"),
     )
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -357,6 +362,11 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 f"HorovodRunner job failed (exit codes {exit_codes}).",
                 exit_codes,
             )
+
+        # Drain the control plane: all workers have exited, so their
+        # connections are at EOF — process every buffered frame before
+        # returning (no tail-of-job log lines lost).
+        server.wait_drained(5.0)
 
         result_bytes = None
         deadline = time.monotonic() + 30

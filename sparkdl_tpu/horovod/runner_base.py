@@ -68,15 +68,18 @@ class HorovodRunner:
               reference's own unit tests lock in (reference
               ``tests/horovod/runner_base_test.py:44-59``).
 
-        :param driver_log_verbosity: driver log verbosity, "all" or
-            "log_callback_only" (default). "all" streams every worker's
-            logs to the driver in real time (may be noisy during
-            training, reference ``runner_base.py:65-68``); the default
-            surfaces only logs sent via
+        :param driver_log_verbosity: driver log verbosity for CLUSTER
+            jobs (np >= 0): "all" streams every worker's logs to the
+            driver in real time (may be noisy during training,
+            reference ``runner_base.py:65-68``); the default
+            "log_callback_only" surfaces only logs sent via
             :func:`sparkdl_tpu.horovod.log_to_driver` and callbacks
-            built on it (reference ``runner_base.py:68-72``). In both
-            modes the full merged worker logs are written to a job log
-            file (reference ``runner_base.py:62-64``).
+            built on it (reference ``runner_base.py:68-72``). Local
+            subprocess mode (np < 0) always streams training
+            stdout/stderr to the driver output (reference
+            ``README.md:44-47``). In every mode the full merged worker
+            logs are written to a job log file (reference
+            ``runner_base.py:62-64``).
         """
         if not isinstance(np, int) or isinstance(np, bool):
             raise TypeError(

@@ -334,3 +334,22 @@ def test_slot_exhaustion_not_retried(monkeypatch):
     with pytest.raises(RuntimeError, match="fails fast"):
         HorovodRunner(np=8).run(lambda: None)
     assert time.monotonic() - t0 < 30  # no retry loop
+
+
+@pytest.mark.gang
+def test_local_mode_streams_worker_stdout(capfd):
+    """np<0 local mode: training stdout reaches the driver output
+    regardless of verbosity (reference README.md:44-47); np>0 cluster
+    mode keeps the suppression policy."""
+
+    def chatty():
+        import sparkdl_tpu.hvd as hvd
+
+        hvd.init()
+        print(f"stdout from rank {hvd.rank()}")
+        return hvd.size()
+
+    assert HorovodRunner(np=-2).run(chatty) == 2
+    out = capfd.readouterr().out
+    assert "stdout from rank 0" in out
+    assert "stdout from rank 1" in out

@@ -61,12 +61,12 @@ def test_slot_check_fails_fast(fake_pyspark):
 
 
 def test_launcher_falls_back_without_pyspark():
-    """Without pyspark installed at all, cluster mode uses the local
-    gang (exercised constantly by the np>0 tests); the import gate
-    must swallow only ImportError."""
-    import importlib
+    """Without pyspark installed, cluster mode uses the local gang
+    (exercised constantly by the np>0 tests)."""
+    import importlib.util
 
-    assert importlib.util.find_spec("pyspark") is None
+    if importlib.util.find_spec("pyspark") is not None:
+        pytest.skip("pyspark installed; fallback path not applicable")
     from sparkdl_tpu.horovod import launcher
 
     # _resolve_num_workers works and launch path exists
