@@ -128,6 +128,8 @@ def _split_stage(hist_g, hist_h, feature_mask, *, reg_lambda, reg_alpha,
 
     def score(gs, hs):
         return soft(gs) ** 2 / (hs + reg_lambda)
+    # (best_gain is also surfaced so trees can report per-feature gain
+    # importances, xgboost sklearn-API parity)
 
     miss_g = hist_g[..., n_bins]          # (nodes, F)
     miss_h = hist_h[..., n_bins]
@@ -141,9 +143,12 @@ def _split_stage(hist_g, hist_h, feature_mask, *, reg_lambda, reg_alpha,
     parent = score(g_tot[..., :1, None], h_tot[..., :1, None])
 
     def split_gain(gl_, hl_):
+        # RAW loss improvement (xgboost's loss_chg); gamma is applied
+        # only as the split-acceptance threshold below, so reported
+        # gains match xgboost's importances under nonzero gamma.
         gr_ = g_tot[..., None] - gl_
         hr_ = h_tot[..., None] - hl_
-        gain = 0.5 * (score(gl_, hl_) + score(gr_, hr_) - parent) - gamma
+        gain = 0.5 * (score(gl_, hl_) + score(gr_, hr_) - parent)
         ok = (hl_ >= min_child_weight) & (hr_ >= min_child_weight)
         return jnp.where(ok, gain, -jnp.inf)
 
@@ -165,8 +170,8 @@ def _split_stage(hist_g, hist_h, feature_mask, *, reg_lambda, reg_alpha,
     leaf_w = -learning_rate * soft(g_tot[:, 0]) / (h_tot[:, 0] + reg_lambda)
     empty = h_tot[:, 0] <= 0.0
     leaf_w = jnp.where(empty, 0.0, leaf_w)
-    do_split = best_gain > 0.0
-    return do_split, best_feat, best_thr, best_ml, leaf_w
+    do_split = best_gain > gamma
+    return do_split, best_feat, best_thr, best_ml, leaf_w, best_gain
 
 
 def _route_stage(binned, pos, level_start, do_split, feat, thr,
@@ -223,6 +228,7 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
     n_bins = n_bins_tot - 1
     n_nodes = 2 ** (max_depth + 1) - 1
     feat_arr = jnp.zeros((n_nodes,), jnp.int32)
+    gain_arr = jnp.zeros((n_nodes,), jnp.float32)
     thr_arr = jnp.zeros((n_nodes,), jnp.int32)
     ml_arr = jnp.zeros((n_nodes,), bool)
     split_arr = jnp.zeros((n_nodes,), bool)
@@ -236,7 +242,7 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
             binned, g, h, pos, level_start,
             nodes_d=nodes_d, n_bins_tot=n_bins_tot,
         )
-        do_split, bf, bt, bml, leaf_w = _split_stage(
+        do_split, bf, bt, bml, leaf_w, gains = _split_stage(
             hg, hh, feature_mask, reg_lambda=reg_lambda,
             reg_alpha=reg_alpha, gamma=gamma,
             min_child_weight=min_child_weight,
@@ -246,6 +252,9 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
             do_split = jnp.zeros_like(do_split)
         sl = slice(level_start, level_start + nodes_d)
         feat_arr = feat_arr.at[sl].set(bf)
+        gain_arr = gain_arr.at[sl].set(
+            jnp.where(do_split, jnp.maximum(gains, 0.0), 0.0)
+        )
         thr_arr = thr_arr.at[sl].set(bt)
         ml_arr = ml_arr.at[sl].set(bml)
         split_arr = split_arr.at[sl].set(do_split)
@@ -260,7 +269,7 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
         binned, feat_arr, thr_arr, ml_arr, split_arr, leaf_arr,
         max_depth=max_depth, n_bins=n_bins,
     )
-    return feat_arr, thr_arr, ml_arr, split_arr, leaf_arr, delta
+    return feat_arr, thr_arr, ml_arr, split_arr, leaf_arr, gain_arr, delta
 
 
 # ---------------------------------------------------------------------------
@@ -403,7 +412,11 @@ class Booster:
         trees = []
         keys = ("feat", "thr", "missing_left", "is_split", "leaf_w")
         for i in range(meta["n_trees"]):
-            trees.append({k: data[f"t{i}_{k}"] for k in keys})
+            t = {k: data[f"t{i}_{k}"] for k in keys}
+            gk = f"t{i}_gain"
+            t["gain"] = (data[gk] if gk in data
+                         else np.zeros_like(t["leaf_w"]))
+            trees.append(t)
         missing = np.nan if meta["missing"] is None else meta["missing"]
         base = meta["base_score"]
         if isinstance(base, list):
@@ -443,6 +456,35 @@ class Booster:
         if obj == "multi:softprob":
             return m.argmax(axis=1).astype(np.int32)
         return m[:, 0]
+
+    def feature_importances(self, importance_type="gain"):
+        """Per-feature importances over the forest (xgboost sklearn-API
+        semantics): ``gain`` = AVERAGE raw split gain per feature,
+        ``total_gain`` = summed gains, ``weight`` = split counts — all
+        normalized to sum to 1."""
+        if importance_type not in ("gain", "total_gain", "weight"):
+            raise ValueError(
+                "importance_type must be 'gain', 'total_gain' or "
+                f"'weight', got {importance_type!r}"
+            )
+        n_features = self.edges.shape[0]
+        gain_sum = np.zeros((n_features,), np.float64)
+        counts = np.zeros((n_features,), np.float64)
+        for t in self.trees:
+            feats = t["feat"][t["is_split"]]
+            np.add.at(gain_sum, feats, t["gain"][t["is_split"]])
+            np.add.at(counts, feats, 1.0)
+        if importance_type == "weight":
+            acc = counts
+        elif importance_type == "total_gain":
+            acc = gain_sum
+        else:  # xgboost's 'gain': average gain per split
+            acc = np.divide(
+                gain_sum, counts, out=np.zeros_like(gain_sum),
+                where=counts > 0,
+            )
+        total = acc.sum()
+        return (acc / total if total > 0 else acc).astype(np.float32)
 
     def predict_proba(self, X):
         m = self.predict_margin(X)
@@ -601,7 +643,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
             if hist_reduce is None:
                 # Single-process fast path: the whole tree (all levels
                 # + margin delta) is ONE jitted program.
-                bf, bt, bml, bsp, blw, delta = fused_fn(
+                bf, bt, bml, bsp, blw, bg, delta = fused_fn(
                     binned, g, h, feature_mask
                 )
                 tree = {
@@ -610,6 +652,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
                     "missing_left": np.asarray(bml),
                     "is_split": np.asarray(bsp),
                     "leaf_w": np.asarray(blw),
+                    "gain": np.asarray(bg),
                 }
                 delta = np.asarray(delta)
             else:
@@ -619,6 +662,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
                     "missing_left": np.zeros(n_nodes, bool),
                     "is_split": np.zeros(n_nodes, bool),
                     "leaf_w": np.zeros(n_nodes, np.float32),
+                    "gain": np.zeros(n_nodes, np.float32),
                 }
                 pos = np.zeros((n,), np.int32)
                 for d in range(max_depth + 1):
@@ -638,7 +682,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
                     stacked = np.stack([np.asarray(hg), np.asarray(hh)])
                     stacked = hist_reduce(stacked)
                     hg, hh = stacked[0], stacked[1]
-                    do_split, bf, bt, bml, leaf_w = split_fn(
+                    do_split, bf, bt, bml, leaf_w, gains = split_fn(
                         hg, hh, feature_mask
                     )
                     do_split = np.asarray(do_split)
@@ -646,6 +690,9 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
                         do_split = np.zeros_like(do_split)
                     sl = slice(level_start, level_start + nodes_d)
                     tree["feat"][sl] = np.asarray(bf)
+                    tree["gain"][sl] = np.where(
+                        do_split, np.maximum(np.asarray(gains), 0.0), 0.0
+                    )
                     tree["thr"][sl] = np.asarray(bt)
                     tree["missing_left"][sl] = np.asarray(bml)
                     tree["is_split"][sl] = do_split

@@ -412,6 +412,11 @@ class _XgboostModel(Model, _XgboostParams, MLReadable, MLWritable):
         ``xgboost.py:130-134``)."""
         return self._xgb_model
 
+    @property
+    def feature_importances_(self):
+        """Gain-based per-feature importances (xgboost sklearn parity)."""
+        return self._xgb_model.feature_importances("gain")
+
     def _transform(self, dataset):
         pdf, spark_template = to_pandas(dataset)
         pdf = pdf.copy()

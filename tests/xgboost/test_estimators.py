@@ -216,3 +216,19 @@ def test_warm_start_with_early_stopping_keeps_base_trees():
     r1 = float(np.sqrt(np.mean((p1 - df["label"]) ** 2)))
     r2 = float(np.sqrt(np.mean((p2 - df["label"]) ** 2)))
     assert r2 <= r1 + 1e-6
+
+
+def test_feature_importances():
+    """Importances concentrate on the truly informative features
+    (y depends on features 0 and 1 only)."""
+    df = _reg_frame()
+    model = XgboostRegressor(n_estimators=20, max_depth=4).fit(df)
+    imp = model.feature_importances_
+    assert imp.shape == (3,)
+    np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-5)
+    assert imp[0] + imp[1] > 0.9  # feature 2 is noise
+    for kind in ("weight", "total_gain"):
+        w = model.get_booster().feature_importances(kind)
+        np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-5)
+    with pytest.raises(ValueError, match="importance_type"):
+        model.get_booster().feature_importances("cover")
