@@ -1,0 +1,86 @@
+"""Tensor-parallel numeric equivalence: the same model with params
+GSPMD-sharded over ('data','model') must produce the same outputs as
+the unsharded single-device run — the correctness guarantee behind
+"annotate shardings, let XLA insert collectives"."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+from sparkdl_tpu.parallel.sharding import TRANSFORMER_RULES, param_sharding
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshSpec(data=2, model=4))
+
+
+def test_llama_tp_matches_unsharded(mesh):
+    from sparkdl_tpu.models import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(d_model=64, n_heads=4, n_kv_heads=4,
+                           d_ff=128, dtype=jnp.float32)
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    ref = np.asarray(model.apply({"params": params}, ids))
+
+    shardings = param_sharding(params, TRANSFORMER_RULES, mesh)
+    params_sharded = jax.device_put(params, shardings)
+    with mesh:
+        out = np.asarray(
+            jax.jit(lambda p, t: model.apply({"params": p}, t))(
+                params_sharded, ids
+            )
+        )
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_tp_matches_unsharded(mesh):
+    from sparkdl_tpu.models import BertConfig, BertForSequenceClassification
+
+    cfg = BertConfig.tiny(d_model=32, n_heads=2, d_ff=64,
+                          dtype=jnp.float32)
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    ref = np.asarray(model.apply({"params": params}, ids))
+
+    shardings = param_sharding(params, TRANSFORMER_RULES, mesh)
+    params_sharded = jax.device_put(params, shardings)
+    with mesh:
+        out = np.asarray(
+            jax.jit(lambda p, t: model.apply({"params": p}, t))(
+                params_sharded, ids
+            )
+        )
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_sharded_checkpoint_restore(mesh, tmp_path):
+    """Checkpoint written from sharded arrays restores to the SAME
+    shardings via an abstract target (multi-chip resume path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    sharding = NamedSharding(mesh, P("model", None))
+    x = jax.device_put(
+        jnp.arange(32.0).reshape(8, 4), sharding
+    )
+    ckpt = TrainCheckpointer(str(tmp_path / "sharded"))
+    try:
+        ckpt.save(0, {"w": x})
+        target = {"w": jax.ShapeDtypeStruct((8, 4), jnp.float32,
+                                            sharding=sharding)}
+        restored = ckpt.restore(target=target)
+        assert restored["w"].sharding == sharding
+        np.testing.assert_allclose(
+            np.asarray(restored["w"]), np.asarray(x)
+        )
+    finally:
+        ckpt.close()
