@@ -181,9 +181,12 @@ class Params:
         that._defaultParamMap = dict(self._defaultParamMap)
         if extra:
             for param, value in extra.items():
-                that._paramMap[that._resolveParam(
-                    param.name if isinstance(param, Param) else param
-                )] = value
+                name = param.name if isinstance(param, Param) else param
+                # extra maps may carry params for OTHER instances (e.g.
+                # a Pipeline distributing a grid to its stages): apply
+                # only the ones this instance owns.
+                if that.hasParam(name):
+                    that._paramMap[that.getParam(name)] = value
         return that
 
     def _copyValues(self, to, extra=None):
