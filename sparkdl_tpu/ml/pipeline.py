@@ -231,13 +231,21 @@ class TrainValidationSplit(Estimator):
         )
 
 
-# -- evaluators -------------------------------------------------------------
+# -- evaluator shorthands (delegate to sparkdl_tpu.ml.evaluation) -----------
 
 
 def accuracy_evaluator(df, label_col, prediction_col):
-    return float((df[prediction_col] == df[label_col]).mean())
+    from sparkdl_tpu.ml.evaluation import MulticlassClassificationEvaluator
+
+    return MulticlassClassificationEvaluator(
+        labelCol=label_col, predictionCol=prediction_col,
+        metricName="accuracy",
+    ).evaluate(df)
 
 
 def neg_rmse_evaluator(df, label_col, prediction_col):
-    err = df[prediction_col].to_numpy() - df[label_col].to_numpy()
-    return -float(np.sqrt(np.mean(err ** 2)))
+    from sparkdl_tpu.ml.evaluation import RegressionEvaluator
+
+    return -RegressionEvaluator(
+        labelCol=label_col, predictionCol=prediction_col,
+    ).evaluate(df)

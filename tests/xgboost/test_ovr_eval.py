@@ -1,0 +1,97 @@
+"""OneVsRest + evaluators (completing the reference's named meta-
+algorithm list, xgboost.py:167-169)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sparkdl.xgboost import XgboostClassifier, XgboostRegressor
+from sparkdl_tpu.ml.classification import OneVsRest
+from sparkdl_tpu.ml.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+
+
+def _multi_frame(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float32)
+    return pd.DataFrame({"features": list(X), "label": y})
+
+
+def test_one_vs_rest_multiclass():
+    df = _multi_frame()
+    ovr = OneVsRest(classifier=XgboostClassifier(n_estimators=15,
+                                                 max_depth=3))
+    model = ovr.fit(df)
+    assert len(model.models) == 3
+    out = model.transform(df)
+    acc = MulticlassClassificationEvaluator().evaluate(out)
+    assert acc > 0.9
+    f1 = MulticlassClassificationEvaluator(metricName="f1").evaluate(out)
+    assert f1 > 0.9
+
+
+def test_binary_evaluator_auc():
+    df = _multi_frame()
+    df["label"] = (df["label"] > 0).astype(np.float32)
+    model = XgboostClassifier(n_estimators=15, max_depth=3).fit(df)
+    out = model.transform(df)
+    auc = BinaryClassificationEvaluator().evaluate(out)
+    assert auc > 0.95
+    # degenerate single-class input → 0.5
+    single = out[out["label"] == 1.0]
+    assert BinaryClassificationEvaluator().evaluate(single) == 0.5
+
+
+def test_regression_evaluator_metrics():
+    rng = np.random.RandomState(2)
+    X = rng.randn(200, 3).astype(np.float32)
+    y = X[:, 0] * 2
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = XgboostRegressor(n_estimators=20, max_depth=3).fit(df)
+    out = model.transform(df)
+    rmse = RegressionEvaluator().evaluate(out)
+    r2 = RegressionEvaluator(metricName="r2").evaluate(out)
+    assert rmse < 0.5
+    assert r2 > 0.9
+    # tuning-callable orientation: rmse flips sign (higher is better)
+    ev = RegressionEvaluator()
+    assert ev(out) == -rmse
+    with pytest.raises(ValueError, match="metricName"):
+        RegressionEvaluator(metricName="mape").evaluate(out)
+
+
+def test_ovr_custom_label_col():
+    """Regression: labelCol propagates into the sub-classifiers."""
+    df = _multi_frame().rename(columns={"label": "target"})
+    ovr = OneVsRest(
+        classifier=XgboostClassifier(n_estimators=10, max_depth=3),
+        labelCol="target",
+    )
+    out = ovr.fit(df).transform(df)
+    acc = (out["prediction"] == df["target"]).mean()
+    assert acc > 0.9
+
+
+def test_auc_tie_handling():
+    """Tied scores across classes must give AUC 0.5, not 1.0."""
+    ev = BinaryClassificationEvaluator()
+    df = pd.DataFrame({
+        "label": [0.0, 1.0, 0.0, 1.0],
+        "rawPrediction": [[0.0, 1.0]] * 4,   # all scores tied
+    })
+    assert ev.evaluate(df) == 0.5
+
+
+def test_binary_evaluator_pr_and_validation():
+    df = _multi_frame()
+    df["label"] = (df["label"] > 0).astype(np.float32)
+    model = XgboostClassifier(n_estimators=10, max_depth=3).fit(df)
+    out = model.transform(df)
+    pr = BinaryClassificationEvaluator(metricName="areaUnderPR").evaluate(out)
+    assert pr > 0.9
+    with pytest.raises(ValueError, match="metricName"):
+        BinaryClassificationEvaluator(metricName="logLoss").evaluate(out)
