@@ -1,0 +1,22 @@
+# Developer entry points.
+
+.PHONY: test test-fast bench native docs clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:          # skip multiprocess gang tests (each worker imports jax/tf)
+	python -m pytest tests/ -q -m "not gang"
+
+bench:              # single-chip headline bench (run on a TPU host)
+	python bench.py
+
+native:             # build the C++ control-plane transport
+	$(MAKE) -C native
+
+docs:
+	cd docs && PYTHONPATH=.. $(MAKE) html
+
+clean:
+	rm -rf native/build docs/_build
+	find . -name __pycache__ -type d -exec rm -rf {} +
