@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Headline-bench configuration sweep (run on a TPU host): measures the
+bench.py workload under candidate configs so the best one can be
+promoted into bench.py. Prints one JSON line per variant.
+
+Variants: attention policy (XLA reference vs pallas flash with the
+fused backward), batch size, remat.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+
+import numpy as np
+
+
+def measure(attention, batch, seq, remat=False, n_steps=20):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.models import Llama, LlamaConfig, lora_mask
+    from sparkdl_tpu.parallel.train import (
+        cross_entropy_loss,
+        make_train_step,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16, lora_rank=16,
+        attention=attention,
+    )
+    model = Llama(cfg)
+    tokens = np.zeros((batch, seq), np.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    mask = lora_mask(params)
+    opt = optax.masked(optax.adamw(1e-4), mask)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["inputs"])
+        return cross_entropy_loss(logits, b["targets"])
+
+    step = make_train_step(loss_fn, opt, param_mask=mask, remat=remat)
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "inputs": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+        "targets": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+    }
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run_n(params, opt_state, b):
+        def body(carry, _):
+            p, s = carry
+            p, s, m = step(p, s, b)
+            return (p, s), m["loss"]
+
+        (p, s), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=n_steps)
+        return p, s, losses[-1]
+
+    params, opt_state, last = run_n(params, opt_state, batch_data)
+    _ = np.asarray(last)
+    t0 = time.perf_counter()
+    params, opt_state, last = run_n(params, opt_state, batch_data)
+    _ = np.asarray(last)
+    dt = time.perf_counter() - t0
+    return n_steps * batch * seq / dt
+
+
+def main():
+    variants = [
+        {"attention": "reference", "batch": 8, "seq": 1024},
+        {"attention": "flash", "batch": 8, "seq": 1024},
+        {"attention": "reference", "batch": 16, "seq": 1024},
+        {"attention": "flash", "batch": 16, "seq": 1024},
+        {"attention": "flash", "batch": 4, "seq": 4096, "remat": True},
+        {"attention": "reference", "batch": 4, "seq": 4096, "remat": True},
+    ]
+    for v in variants:
+        try:
+            tps = measure(**v)
+            print(json.dumps({**v, "tokens_per_sec": round(tps, 1)}),
+                  flush=True)
+        except Exception as e:  # keep sweeping on OOM etc.
+            print(json.dumps({**v, "error": str(e)[:200]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
