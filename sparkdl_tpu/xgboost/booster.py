@@ -522,6 +522,8 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     colsample_bytree = float(p.pop("colsample_bytree", 1.0))
     max_bins = int(p.pop("max_bin", p.pop("max_bins", 256)))
     missing = p.pop("missing", np.nan)
+    scale_pos_weight = float(p.pop("scale_pos_weight", 1.0))
+    user_base_score = p.pop("base_score", None)
     seed = int(p.pop("random_state", p.pop("seed", 0)))
     n_classes = int(p.pop("num_class", 0))
     eval_metric = p.pop("eval_metric", None) or _DEFAULT_METRIC[objective]
@@ -532,6 +534,17 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     n, f = X.shape
     w = (np.ones(n, np.float32) if sample_weight is None
          else np.asarray(sample_weight, np.float32))
+    if scale_pos_weight != 1.0:
+        if objective == "binary:logistic":
+            # xgboost semantics: positive-class instances weighted up
+            w = np.where(y == 1.0, w * scale_pos_weight, w)
+        else:
+            import logging
+
+            logging.getLogger("sparkdl.xgboost").warning(
+                "scale_pos_weight only applies to binary:logistic; "
+                "ignored for objective %r.", objective,
+            )
 
     if xgb_model is not None:
         edges = xgb_model.edges
@@ -554,6 +567,21 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     if xgb_model is not None:
         base_score = xgb_model.base_score
         base = np.asarray(base_score, np.float32).reshape(-1)
+    elif user_base_score is not None:
+        # user-provided base_score (xgboost semantics: a probability
+        # for logistic objectives → logit margin; raw value otherwise)
+        b = float(user_base_score)
+        if objective == "binary:logistic":
+            if not 0.0 < b < 1.0:
+                raise ValueError(
+                    f"base_score must be in (0, 1) for binary:logistic; "
+                    f"got {b}"
+                )
+            b = float(np.log(b / (1.0 - b)))
+        base_score = np.float32(b)
+        base = np.full((max(k, 1),), base_score, np.float32)
+        if k > 1:
+            base_score = base
     elif objective == "reg:squarederror":
         ssum = np.array([np.sum(y * w), np.sum(w)], np.float64)
         if hist_reduce is not None:

@@ -90,6 +90,25 @@ _BOOSTER_PARAM_DEFS = {
     "xgb_model": (None, TypeConverters.identity,
                   "a Booster to continue training from (the value "
                   "returned by model.get_booster())."),
+    "scale_pos_weight": (1.0, TypeConverters.toFloat,
+                         "weight multiplier for positive-class rows "
+                         "(binary objectives)."),
+    "base_score": (None, TypeConverters.toFloat,
+                   "initial prediction: a probability for logistic "
+                   "objectives, a raw value otherwise."),
+}
+
+# xgboost.XGBClassifier params that have no effect on this runtime
+# (threading/GPU/booster-variant knobs): accepted with a warning, so
+# mains written against xgboost's sklearn API run unmodified — the
+# "automatically supports most of the parameters" posture (reference
+# xgboost.py:253-256) without silently absorbing typos.
+_IGNORED_PARAMS = {
+    "n_jobs", "nthread", "verbosity", "silent", "booster",
+    "enable_categorical", "max_cat_to_onehot", "predictor",
+    "sampling_method", "monotone_constraints", "interaction_constraints",
+    "importance_type", "device", "grow_policy", "max_leaves",
+    "colsample_bylevel", "colsample_bynode", "max_delta_step",
 }
 
 # Params the reference explicitly rejects, with the replacement the user
@@ -190,6 +209,12 @@ class _XgboostParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                     f"Param {k!r} is not supported (reference contract"
                     f"{hint})."
                 )
+            if k in _IGNORED_PARAMS:
+                logger.warning(
+                    "Param %r has no effect on the TPU booster and is "
+                    "ignored.", k,
+                )
+                continue
             if not self.hasParam(k):
                 raise ValueError(
                     f"Unknown param {k!r}. Discoverable params are the "

@@ -232,3 +232,45 @@ def test_feature_importances():
         np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-5)
     with pytest.raises(ValueError, match="importance_type"):
         model.get_booster().feature_importances("cover")
+
+
+def test_ignored_xgboost_params_warn_not_raise(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="sparkdl.xgboost"):
+        clf = XgboostClassifier(n_estimators=3, n_jobs=8, verbosity=0)
+    assert "no effect" in caplog.text
+    # and training still works
+    clf.fit(_clf_frame(n=100))
+
+
+def test_scale_pos_weight_shifts_recall():
+    rng = np.random.RandomState(5)
+    X = rng.randn(600, 3).astype(np.float32)
+    y = (X[:, 0] > 1.0).astype(np.float32)  # ~16% positives
+    df = pd.DataFrame({"features": list(X), "label": y})
+    base = XgboostClassifier(n_estimators=10, max_depth=3).fit(df)
+    heavy = XgboostClassifier(
+        n_estimators=10, max_depth=3, scale_pos_weight=10.0
+    ).fit(df)
+    raw_base = np.stack(base.transform(df)["rawPrediction"].to_numpy())
+    raw_heavy = np.stack(heavy.transform(df)["rawPrediction"].to_numpy())
+    # positive-row margins shift strictly upward — fails if the
+    # weighting ever becomes a silent no-op
+    pos = y == 1
+    assert raw_heavy[pos, 1].mean() > raw_base[pos, 1].mean() + 0.05
+    rec_heavy = (heavy.transform(df)["prediction"][y == 1] == 1).mean()
+    assert rec_heavy > 0.9
+
+
+def test_user_base_score_regression():
+    df = _reg_frame(n=100)
+    m = XgboostRegressor(n_estimators=0, base_score=5.0).fit(df)
+    out = m.transform(df)
+    np.testing.assert_allclose(out["prediction"], 5.0, atol=1e-6)
+
+
+def test_base_score_validated_for_logistic():
+    df = _clf_frame(n=60)
+    with pytest.raises(ValueError, match="base_score"):
+        XgboostClassifier(n_estimators=2, base_score=1.0).fit(df)
