@@ -225,3 +225,26 @@ def test_keras_warmup_and_metric_callbacks_local():
         )
         # size==1: warmup/averaging are no-ops; training proceeded
         assert len(hist.history["loss"]) == 2
+
+
+def test_log_callback_per_batch(capfd):
+    """per_batch_log=True streams batch lines (reference keras.py:25)."""
+    import numpy as np
+    import tensorflow as tf
+
+    from sparkdl.horovod.tensorflow.keras import LogCallback
+    from sparkdl_tpu.hvd import _state
+
+    with _state.local_mode():
+        model = tf.keras.Sequential(
+            [tf.keras.Input((4,)), tf.keras.layers.Dense(1)]
+        )
+        model.compile(optimizer="sgd", loss="mse")
+        x = np.random.randn(32, 4).astype("float32")
+        y = x.sum(1, keepdims=True).astype("float32")
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0,
+                  callbacks=[LogCallback(per_batch_log=True)])
+    out = capfd.readouterr().out
+    assert "Epoch 0 begin" in out
+    assert "batch 0" in out and "batch 3" in out
+    assert "Epoch 0 end" in out

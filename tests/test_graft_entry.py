@@ -1,0 +1,30 @@
+"""Driver-entry-point regression tests: the dryrun must keep compiling
+and running across refactors (the driver validates with virtual CPU
+devices; this is the in-suite canary)."""
+
+import importlib.util
+import os
+
+
+def _load_graft():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "__graft_entry__.py",
+    )
+    spec = importlib.util.spec_from_file_location("graft_entry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dryrun_multichip_two_devices():
+    _load_graft().dryrun_multichip(2)
+
+
+def test_entry_forward_shapes():
+    import jax
+
+    g = _load_graft()
+    fn, (params, tokens) = g.entry()
+    out = jax.eval_shape(fn, params, tokens)
+    assert out.shape == (tokens.shape[0], tokens.shape[1], 32000)
