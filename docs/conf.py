@@ -34,3 +34,11 @@ autodoc_mock_imports = ["tensorflow", "torch", "pyspark"]
 master_doc = "index"
 exclude_patterns = ["_build"]
 html_theme = "classic"
+templates_path = ["_templates"]
+html_static_path = ["static"]
+
+# Unlike the reference, whose docstrings are epytext and need the
+# docs/epytext.py autodoc rewrite hook, every docstring here is native
+# reStructuredText — no converter plugin required. (The reference's
+# underscores.py GH-Pages _static rename is likewise unnecessary for
+# standard hosting.)
