@@ -34,8 +34,9 @@ autodoc_mock_imports = ["tensorflow", "torch", "pyspark"]
 master_doc = "index"
 exclude_patterns = ["_build"]
 html_theme = "classic"
-templates_path = ["_templates"]
 html_static_path = ["static"]
+html_css_files = ["sparkdl_tpu.css"]  # the docs skin (reference ships
+# a classic-theme skin the same way, docs/static/pysparkdl.css)
 
 # Unlike the reference, whose docstrings are epytext and need the
 # docs/epytext.py autodoc rewrite hook, every docstring here is native

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Llama LoRA fine-tune, JAX/pjit, launched through HorovodRunner
+(BASELINE.json config 5 — the north-star path)."""
+
+import sys
+
+from sparkdl import HorovodRunner
+
+
+def train(steps=20):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.models import Llama, LlamaConfig, lora_mask
+    from sparkdl_tpu.parallel.train import (
+        cross_entropy_loss,
+        make_train_step,
+    )
+
+    hvd.init()
+    cfg = LlamaConfig(
+        vocab_size=32000, d_model=512, n_layers=4, n_heads=8,
+        n_kv_heads=4, d_ff=1536, lora_rank=8,
+    )
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    tokens0 = jnp.zeros((4, 256), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+    mask = lora_mask(params)
+    opt = optax.masked(optax.adamw(1e-4), mask)
+    step = jax.jit(make_train_step(
+        lambda p, b: cross_entropy_loss(
+            model.apply({"params": p}, b["inputs"]), b["targets"]),
+        opt, param_mask=mask,
+    ))
+    state = opt.init(params)
+    for i in range(steps):
+        ids = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (4, 257)), jnp.int32)
+        batch = {"inputs": ids[:, :-1], "targets": ids[:, 1:]}
+        params, state, m = step(params, state, batch)
+        # average the reported loss across the gang, Horovod-style
+        if i % 5 == 0:
+            loss = float(hvd.allreduce(
+                np.asarray(m["loss"], np.float32)[None])[0])
+            if hvd.rank() == 0:
+                print(f"step {i}: loss {loss:.4f}")
+    return float(m["loss"])
+
+
+if __name__ == "__main__":
+    np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -1
+    print("final loss:", HorovodRunner(np=np_arg).run(train))

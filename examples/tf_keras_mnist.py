@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""MNIST Keras CNN under HorovodRunner (BASELINE.json config 1; the
+reference README's canonical example shape, reference README.md:33-54).
+
+Run locally:          python examples/tf_keras_mnist.py
+Local 4-process gang: python examples/tf_keras_mnist.py -4
+Cluster gang:         python examples/tf_keras_mnist.py 8
+"""
+
+import sys
+
+from sparkdl import HorovodRunner
+
+
+def train_hvd(learning_rate=0.05, epochs=2):
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod.tensorflow.keras as hvd
+    from sparkdl.horovod.tensorflow.keras import LogCallback
+
+    hvd.init()
+
+    # synthetic MNIST-shaped data so the example runs offline; swap in
+    # tf.keras.datasets.mnist.load_data() when you have the real thing
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(2048, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, 2048)
+
+    model = tf.keras.Sequential([
+        tf.keras.Input((28, 28, 1)),
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.Conv2D(64, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    # Horovod recipe: scale LR by gang size, wrap the optimizer,
+    # broadcast initial state from rank 0.
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate * hvd.size())
+    )
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+    model.fit(
+        x, y, batch_size=64, epochs=epochs, verbose=0,
+        callbacks=[
+            hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd.callbacks.MetricAverageCallback(),
+            LogCallback(),
+        ],
+    )
+    return float(model.evaluate(x, y, verbose=0)[1])
+
+
+if __name__ == "__main__":
+    np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -1
+    acc = HorovodRunner(np=np_arg).run(train_hvd)
+    print(f"final accuracy (rank 0): {acc:.3f}")
