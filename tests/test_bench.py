@@ -1,0 +1,59 @@
+"""Bench harness machinery tests (cpu, tiny config).
+
+The driver runs ``python bench.py`` and requires exactly one JSON line
+on stdout; round 1 died hanging on a wedged accelerator lease, so the
+bounded-probe orchestration is contract, not decoration.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True,
+        text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.gang
+def test_bench_emits_single_json_line_on_cpu():
+    r = _run({
+        "SPARKDL_TPU_BENCH_PLATFORM": "cpu",
+        "SPARKDL_TPU_BENCH_TINY": "1",
+    })
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "llama_lora_train_tokens_per_sec_per_chip"
+    assert out["unit"] == "tokens/sec/chip"
+    assert out["value"] > 0
+    assert out["vs_baseline"] is not None
+    assert 0 <= out["mfu"] < 1
+    assert out["platform"] == "cpu"
+
+
+def test_bench_fails_fast_when_backend_unavailable():
+    # an unknown platform name fails backend init on every host; the
+    # orchestrator must emit an error JSON line and exit nonzero
+    # quickly instead of hanging.
+    r = _run({
+        "SPARKDL_TPU_BENCH_PLATFORM": "nosuchplatform",
+        "SPARKDL_TPU_BENCH_TINY": "1",
+        "SPARKDL_TPU_BENCH_PROBE_TIMEOUT": "60",
+        "SPARKDL_TPU_BENCH_PROBE_PAUSE": "1",
+    }, timeout=200)
+    assert r.returncode != 0
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] is None
+    assert "unavailable" in out["error"]
