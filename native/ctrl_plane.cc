@@ -16,11 +16,17 @@
 // big-endian, len = payload + 5.
 //
 // C API (ctypes-friendly), all functions thread-safe:
-//   void*    sdl_sender_create(host, port, rank, capacity_bytes)
+//   int      sdl_abi_version()                        // loader handshake
+//   void*    sdl_sender_create(host, port, rank, capacity_bytes,
+//                              preamble, preamble_len)
 //   int      sdl_sender_send(s, type, payload, len)   // 0 ok, 1 dropped
 //   uint64_t sdl_sender_dropped(s)
 //   int      sdl_sender_flush(s, timeout_ms)          // 0 drained
 //   void     sdl_sender_close(s)
+//
+// The preamble is an opaque byte string written verbatim right after
+// every successful connect — the Python layer passes the job's AUTH
+// frame so this connection passes the driver's handshake.
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -49,9 +55,13 @@ struct Frame {
 class Sender {
  public:
   Sender(const std::string& host, int port, uint32_t rank,
-         size_t capacity_bytes)
+         size_t capacity_bytes, const uint8_t* preamble,
+         uint32_t preamble_len)
       : host_(host), port_(port), rank_(rank),
         capacity_(capacity_bytes), fd_(-1) {
+    if (preamble != nullptr && preamble_len > 0) {
+      preamble_.assign(preamble, preamble + preamble_len);
+    }
     thread_ = std::thread([this] { Drain(); });
   }
 
@@ -144,6 +154,12 @@ class Sender {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     fd_ = fd;
+    if (!preamble_.empty() &&
+        !SendAll(preamble_.data(), preamble_.size())) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;
+    }
     return true;
   }
 
@@ -204,6 +220,7 @@ class Sender {
   int port_;
   uint32_t rank_;
   size_t capacity_;
+  std::vector<uint8_t> preamble_;
   int fd_;
   std::mutex mu_;
   std::condition_variable cv_;
@@ -220,9 +237,15 @@ class Sender {
 
 extern "C" {
 
+// Bumped whenever the C API changes shape; the Python loader refuses
+// (and rebuilds) a cached .so whose version doesn't match.
+int sdl_abi_version() { return 2; }
+
 void* sdl_sender_create(const char* host, int port, uint32_t rank,
-                        size_t capacity_bytes) {
-  return new Sender(host, port, rank, capacity_bytes);
+                        size_t capacity_bytes, const uint8_t* preamble,
+                        uint32_t preamble_len) {
+  return new Sender(host, port, rank, capacity_bytes, preamble,
+                    preamble_len);
 }
 
 int sdl_sender_send(void* s, uint8_t type, const uint8_t* payload,

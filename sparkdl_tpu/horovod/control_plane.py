@@ -22,8 +22,11 @@ Frame format: ``u32 length | u8 type | u32 rank | payload`` (big endian).
 JSON payloads for control messages; raw cloudpickle bytes for RESULT.
 """
 
+import hashlib
+import hmac
 import json
 import os
+import secrets as _secrets
 import socket
 import struct
 import threading
@@ -36,11 +39,39 @@ MSG_USERLOG = 3
 MSG_RESULT = 4
 MSG_EXC = 5
 MSG_BYE = 6
+MSG_AUTH = 7
 
 _HEADER = struct.Struct(">IBI")  # length (of type+rank+payload), type, rank
 
 CONTROL_ADDR_ENV = "SPARKDL_TPU_CONTROL_ADDR"
 RANK_ENV = "SPARKDL_TPU_RANK"
+CONTROL_SECRET_ENV = "SPARKDL_TPU_CONTROL_SECRET"
+
+# The driver cloudpickle.loads() the RESULT payload, so an attacker who
+# can deliver frames can execute code on the driver. Every connection
+# must therefore open with an AUTH frame proving knowledge of the
+# per-job secret (distributed to workers via the job env, never over
+# the wire). A frame-length cap bounds allocation from untrusted peers.
+MAX_FRAME = 64 << 20
+
+
+def auth_token(secret, rank):
+    """Per-rank connection credential: HMAC-SHA256 over the rank so the
+    raw job secret never crosses the wire."""
+    return hmac.new(
+        secret.encode("utf-8"),
+        b"sparkdl-tpu-auth-v1" + struct.pack(">I", rank),
+        hashlib.sha256,
+    ).digest()
+
+
+def auth_frame(secret, rank):
+    """The complete wire frame a client must send first on connect."""
+    token = auth_token(secret, rank)
+    return _HEADER.pack(len(token) + 5, MSG_AUTH, rank) + token
+
+
+_AUTH_FRAME_LEN = len(auth_frame("", 0))  # fixed size: header + HMAC-SHA256
 
 # Guard against a runaway worker flooding the driver (backpressure
 # contract, reference runner_base.py:65-68): log text is truncated by
@@ -87,9 +118,12 @@ class ControlPlaneServer:
     """
 
     def __init__(self, num_workers, verbosity="log_callback_only", log_path=None,
-                 bind_host="127.0.0.1", advertise_host=None):
+                 bind_host="127.0.0.1", advertise_host=None, secret=None):
         self.num_workers = num_workers
         self.verbosity = verbosity
+        # Per-job shared secret; the launcher ships it to workers via
+        # CONTROL_SECRET_ENV. Auto-generated so no caller can forget it.
+        self.secret = secret or _secrets.token_hex(32)
         self.log_path = log_path
         self._log_file = open(log_path, "a", buffering=1) if log_path else None
         self._lock = threading.Lock()
@@ -135,15 +169,64 @@ class ControlPlaneServer:
             t.start()
             self._threads.append(t)
 
+    def _log_server_event(self, text):
+        with self._lock:
+            if self._log_file is not None:
+                self._log_file.write(f"[control-plane] {text}\n")
+
     def _serve_conn(self, conn):
+        auth_rank = None  # rank proven by the AUTH handshake
+        auth_len = _AUTH_FRAME_LEN - _HEADER.size
         try:
             while True:
                 head = _recv_exact(conn, _HEADER.size)
                 if head is None:
                     return
                 length, mtype, rank = _HEADER.unpack(head)
+                if auth_rank is None and length - 5 != auth_len:
+                    # Pre-auth, the ONLY legal frame is the fixed-size
+                    # AUTH frame — an unauthenticated peer must not be
+                    # able to make us buffer anything bigger.
+                    self._log_server_event(
+                        f"pre-auth frame with length {length}; closing"
+                    )
+                    return
+                if length < 5 or length - 5 > MAX_FRAME:
+                    # Bounded allocation from untrusted peers: drop the
+                    # connection rather than trust a u32 length.
+                    self._log_server_event(
+                        f"oversized frame ({length} bytes) from rank "
+                        f"{rank}; closing connection"
+                    )
+                    return
                 payload = _recv_exact(conn, length - 5)
                 if payload is None:
+                    return
+                if auth_rank is None:
+                    # First frame MUST be a valid AUTH; anything else —
+                    # including a bad token — closes the connection
+                    # before a single byte reaches the handlers.
+                    if mtype != MSG_AUTH or not hmac.compare_digest(
+                        payload, auth_token(self.secret, rank)
+                    ):
+                        self._log_server_event(
+                            f"unauthenticated connection (first frame "
+                            f"type {mtype}, claimed rank {rank}); closing"
+                        )
+                        return
+                    auth_rank = rank
+                    continue
+                if mtype == MSG_AUTH:
+                    continue  # re-auth is a no-op
+                if rank != auth_rank:
+                    # The per-rank HMAC binds the connection to ONE
+                    # rank; a frame claiming another (e.g. a worker
+                    # forging rank 0 to plant a RESULT) is a protocol
+                    # violation, not data.
+                    self._log_server_event(
+                        f"rank-{auth_rank} connection sent a frame "
+                        f"claiming rank {rank}; closing"
+                    )
                     return
                 try:
                     self._handle(mtype, rank, payload)
@@ -187,6 +270,15 @@ class ControlPlaneServer:
                 if self._log_file is not None:
                     self._log_file.write(f"[rank {rank} log_to_driver] {msg.get('text', '')}\n")
         elif mtype == MSG_RESULT:
+            if rank != 0:
+                # The contract returns rank 0's value only (reference
+                # runner_base.py:93-95); a RESULT from any other rank is
+                # a protocol violation, not data.
+                self._log_server_event(
+                    f"ignoring RESULT from rank {rank} (only rank 0 may "
+                    "return the job value)"
+                )
+                return
             with self._lock:
                 self._result = payload
                 self._result_rank = rank
@@ -272,11 +364,20 @@ class ControlPlaneClient:
     ``SPARKDL_TPU_NATIVE_LOGS=0`` to force the Python path.
     """
 
-    def __init__(self, address, rank):
+    def __init__(self, address, rank, secret=None):
         host, port = address.rsplit(":", 1)
         self.rank = rank
+        secret = secret or os.environ.get(CONTROL_SECRET_ENV)
+        if not secret:
+            raise RuntimeError(
+                "control-plane client needs the per-job secret "
+                f"({CONTROL_SECRET_ENV} unset): refusing to open an "
+                "unauthenticated channel to the driver"
+            )
+        self._auth = auth_frame(secret, rank)
         self._sock = socket.create_connection((host, int(port)), timeout=30)
         self._sock.settimeout(None)
+        self._sock.sendall(self._auth)
         # Detect a dead driver HOST too (power-off/partition sends no
         # FIN): aggressive TCP keepalive makes the watchdog's recv fail
         # within ~1 minute instead of blocking forever.
@@ -294,7 +395,11 @@ class ControlPlaneClient:
             try:
                 from sparkdl_tpu.native import NativeLogSender
 
-                self._native = NativeLogSender(host, int(port), rank)
+                # The native sender opens its own TCP connection, so it
+                # carries the same auth preamble on every (re)connect.
+                self._native = NativeLogSender(
+                    host, int(port), rank, preamble=self._auth
+                )
             except (RuntimeError, OSError):
                 self._native = None
 

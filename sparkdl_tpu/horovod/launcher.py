@@ -109,7 +109,7 @@ def _resolve_num_workers(np_arg):
 
 
 def _worker_env(base_env, *, rank, size, coordinator, control_addr,
-                payload_path, job_dir, platform):
+                control_secret, payload_path, job_dir, platform):
     env = dict(base_env)
     env.update({
         "SPARKDL_TPU_RANK": str(rank),
@@ -118,6 +118,10 @@ def _worker_env(base_env, *, rank, size, coordinator, control_addr,
         "SPARKDL_TPU_LOCAL_SIZE": str(size),
         "SPARKDL_TPU_COORDINATOR": coordinator,
         "SPARKDL_TPU_CONTROL_ADDR": control_addr,
+        # Per-job credential for the control plane: the driver
+        # cloudpickle-loads the RESULT frame, so only processes holding
+        # this secret may speak to it (env never crosses the network).
+        "SPARKDL_TPU_CONTROL_SECRET": control_secret,
         "SPARKDL_TPU_PAYLOAD": payload_path,
         "SPARKDL_TPU_JOB_DIR": job_dir,
     })
@@ -272,6 +276,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             env = _worker_env(
                 os.environ, rank=r, size=num_workers,
                 coordinator=coordinator, control_addr=server.address,
+                control_secret=server.secret,
                 payload_path=payload_paths[r], job_dir=job_dir,
                 platform=platform,
             )

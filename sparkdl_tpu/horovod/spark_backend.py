@@ -25,7 +25,7 @@ class SparkGangResult:
         self.value = value
 
 
-def _barrier_main(payload_bytes, verbosity, control_addr):
+def _barrier_main(payload_bytes, verbosity, control_addr, control_secret):
     """Runs inside each barrier task (executor-side)."""
 
     def run_partition(_):
@@ -57,6 +57,7 @@ def _barrier_main(payload_bytes, verbosity, control_addr):
         os.environ["SPARKDL_TPU_COORDINATOR"] = coords[0]
         if control_addr:
             os.environ["SPARKDL_TPU_CONTROL_ADDR"] = control_addr
+            os.environ["SPARKDL_TPU_CONTROL_SECRET"] = control_secret
         ctx.barrier()  # gang start: all together (runner_base.py:54-55)
 
         import sparkdl_tpu.hvd as hvd
@@ -100,7 +101,8 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
         payload = cloudpickle.dumps((main, kwargs))
         rdd = sc.parallelize(range(num_workers), num_workers).barrier()
         pickled = rdd.mapPartitions(
-            _barrier_main(payload, driver_log_verbosity, server.address)
+            _barrier_main(payload, driver_log_verbosity, server.address,
+                          server.secret)
         ).collect()
         if not pickled:
             raise RuntimeError("Spark barrier job returned no rank-0 result")

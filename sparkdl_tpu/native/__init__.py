@@ -16,7 +16,15 @@ import threading
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
 )
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libsparkdl_ctrl.so")
+
+# Must match sdl_abi_version() in native/ctrl_plane.cc. The version is
+# part of the FILENAME: dlopen dedups by pathname process-wide, so a
+# stale same-named .so could never be replaced by a rebuild within this
+# process — a new ABI must land at a new path.
+_ABI_VERSION = 2
+_LIB_PATH = os.path.join(
+    _NATIVE_DIR, "build", f"libsparkdl_ctrl.v{_ABI_VERSION}.so"
+)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -62,9 +70,13 @@ def load_ctrl_lib():
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
+        if (not hasattr(lib, "sdl_abi_version")
+                or lib.sdl_abi_version() != _ABI_VERSION):
+            return None
         lib.sdl_sender_create.restype = ctypes.c_void_p
         lib.sdl_sender_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_uint32,
         ]
         lib.sdl_sender_send.restype = ctypes.c_int
         lib.sdl_sender_send.argtypes = [
@@ -84,13 +96,15 @@ def load_ctrl_lib():
 class NativeLogSender:
     """Bounded drop-oldest log transport (native backend)."""
 
-    def __init__(self, host, port, rank, capacity_bytes=4 << 20):
+    def __init__(self, host, port, rank, capacity_bytes=4 << 20,
+                 preamble=b""):
         lib = load_ctrl_lib()
         if lib is None:
             raise RuntimeError("native control-plane library unavailable")
         self._lib = lib
         self._handle = lib.sdl_sender_create(
-            host.encode(), int(port), int(rank), int(capacity_bytes)
+            host.encode(), int(port), int(rank), int(capacity_bytes),
+            preamble, len(preamble),
         )
         # Serializes send/flush against close: the C++ Sender is
         # deleted by close, so a racing send would be use-after-free.

@@ -27,8 +27,8 @@ def _drain(server):
 
 
 def test_ready_barrier_and_result(server):
-    c0 = ControlPlaneClient(server.address, rank=0)
-    c1 = ControlPlaneClient(server.address, rank=1)
+    c0 = ControlPlaneClient(server.address, rank=0, secret=server.secret)
+    c1 = ControlPlaneClient(server.address, rank=1, secret=server.secret)
     c0.send_ready()
     assert not server.wait_ready(0.2)  # only 1/2 ready → fail-fast path
     c1.send_ready()
@@ -41,7 +41,7 @@ def test_ready_barrier_and_result(server):
 
 
 def test_log_routing_default_suppresses_worker_logs(server, capfd, tmp_path):
-    c = ControlPlaneClient(server.address, rank=0)
+    c = ControlPlaneClient(server.address, rank=0, secret=server.secret)
     c.send_log("stdout", "noisy training output")
     c.send_user_log("selected message")
     _drain(server)
@@ -60,7 +60,7 @@ def test_log_routing_all_streams_everything(tmp_path, capfd):
         num_workers=1, verbosity="all", log_path=str(tmp_path / "job.log")
     )
     try:
-        c = ControlPlaneClient(srv.address, rank=3)
+        c = ControlPlaneClient(srv.address, rank=3, secret=srv.secret)
         c.send_log("stderr", "worker chatter")
         _drain(srv)
         assert "worker chatter" in capfd.readouterr().out
@@ -70,7 +70,7 @@ def test_log_routing_all_streams_everything(tmp_path, capfd):
 
 
 def test_exception_collection(server):
-    c = ControlPlaneClient(server.address, rank=1)
+    c = ControlPlaneClient(server.address, rank=1, secret=server.secret)
     c.send_exception("Traceback: boom")
     c.send_bye(1)
     _drain(server)
@@ -83,3 +83,80 @@ def test_worker_client_singleton_absent_outside_jobs():
 
     assert os.environ.get(control_plane.CONTROL_ADDR_ENV) is None
     assert control_plane.get_worker_client() is None
+
+
+# -- authentication (the driver cloudpickle-loads RESULT frames, so the
+# channel must reject unauthenticated peers outright) -------------------
+
+
+def test_unauthenticated_connection_delivers_nothing(server):
+    import socket
+    import struct
+
+    host, port = server.address.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)))
+    # A RESULT frame with no preceding AUTH: must never reach the
+    # handler (a pickled payload here would be driver RCE).
+    payload = b"attacker-pickle"
+    s.sendall(struct.pack(">IBI", len(payload) + 5, 4, 0) + payload)
+    _drain(server)
+    assert server.result_bytes is None
+    # ...and the server closed the connection on us (FIN, or RST when
+    # our unread bytes were still buffered server-side).
+    s.settimeout(2)
+    try:
+        assert s.recv(1) == b""
+    except ConnectionResetError:
+        pass
+    s.close()
+
+
+def test_wrong_secret_rejected(server):
+    import socket
+
+    from sparkdl_tpu.horovod.control_plane import auth_frame
+
+    host, port = server.address.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)))
+    s.sendall(auth_frame("not-the-job-secret", 0))
+    s.settimeout(2)
+    assert s.recv(1) == b""  # handshake failed → connection closed
+    s.close()
+
+
+def test_result_accepted_from_rank0_only(server):
+    c1 = ControlPlaneClient(server.address, rank=1, secret=server.secret)
+    c1.send_result(b"rogue-rank-result")
+    _drain(server)
+    assert server.result_bytes is None
+    c0 = ControlPlaneClient(server.address, rank=0, secret=server.secret)
+    c0.send_result(b"real-result")
+    _drain(server)
+    assert server.result_bytes == b"real-result"
+    c0.close()
+    c1.close()
+
+
+def test_oversized_frame_closes_connection(server):
+    import socket
+    import struct
+
+    from sparkdl_tpu.horovod.control_plane import MAX_FRAME, auth_frame
+
+    host, port = server.address.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)))
+    s.sendall(auth_frame(server.secret, 0))
+    # Claim a frame just past the cap: server must drop the connection
+    # without attempting the allocation.
+    s.sendall(struct.pack(">IBI", MAX_FRAME + 6, 2, 0))
+    s.settimeout(2)
+    assert s.recv(1) == b""
+    s.close()
+
+
+def test_client_refuses_to_run_without_secret(server, monkeypatch):
+    from sparkdl_tpu.horovod.control_plane import CONTROL_SECRET_ENV
+
+    monkeypatch.delenv(CONTROL_SECRET_ENV, raising=False)
+    with pytest.raises(RuntimeError, match="secret"):
+        ControlPlaneClient(server.address, rank=0)

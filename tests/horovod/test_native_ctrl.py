@@ -10,6 +10,7 @@ from sparkdl_tpu.horovod.control_plane import (
     MSG_LOG,
     MSG_USERLOG,
     ControlPlaneServer,
+    auth_frame,
 )
 from sparkdl_tpu.native import NativeLogSender, load_ctrl_lib
 
@@ -24,7 +25,8 @@ def test_native_frames_reach_python_server(tmp_path, capfd):
     )
     try:
         host, port = srv.address.rsplit(":", 1)
-        s = NativeLogSender(host, int(port), rank=3)
+        s = NativeLogSender(host, int(port), rank=3,
+                            preamble=auth_frame(srv.secret, 3))
         s.send(MSG_USERLOG, b'{"text": "native hello"}')
         s.send(MSG_LOG, b'{"stream": "stdout", "text": "native chatter"}')
         assert s.flush(5000)
