@@ -40,6 +40,8 @@ MSG_RESULT = 4
 MSG_EXC = 5
 MSG_BYE = 6
 MSG_AUTH = 7
+MSG_RESULT_PART = 8   # chunk of an oversized RESULT (rank 0 only)
+MSG_RESULT_END = 9    # terminates a chunked RESULT
 
 _HEADER = struct.Struct(">IBI")  # length (of type+rank+payload), type, rank
 
@@ -52,7 +54,19 @@ CONTROL_SECRET_ENV = "SPARKDL_TPU_CONTROL_SECRET"
 # must therefore open with an AUTH frame proving knowledge of the
 # per-job secret (distributed to workers via the job env, never over
 # the wire). A frame-length cap bounds allocation from untrusted peers.
+# Threat model: peers WITHOUT the job secret. Gang workers hold the
+# shared secret and are trusted — any of them could derive another
+# rank's token; the per-connection rank pinning below catches bugs and
+# misrouted frames, not a malicious worker.
 MAX_FRAME = 64 << 20
+
+# RESULTs bigger than one frame (e.g. returned model weights) ship as
+# MSG_RESULT_PART chunks + MSG_RESULT_END, reassembled on the driver
+# up to a separate (authenticated, rank-0-only) total cap.
+RESULT_CHUNK = 32 << 20
+MAX_RESULT_TOTAL = int(
+    os.environ.get("SPARKDL_TPU_MAX_RESULT_BYTES", str(4 << 30))
+)
 
 
 def auth_token(secret, rank):
@@ -131,6 +145,9 @@ class ControlPlaneServer:
         self._done = set()
         self._result = None
         self._result_rank = None
+        self._result_parts = []
+        self._result_parts_bytes = 0
+        self._result_overflow = False
         self._exceptions = {}  # rank -> traceback string
         self._exit_codes = {}
         self._ready_cond = threading.Condition(self._lock)
@@ -220,9 +237,10 @@ class ControlPlaneServer:
                     continue  # re-auth is a no-op
                 if rank != auth_rank:
                     # The per-rank HMAC binds the connection to ONE
-                    # rank; a frame claiming another (e.g. a worker
-                    # forging rank 0 to plant a RESULT) is a protocol
-                    # violation, not data.
+                    # rank; a frame claiming another rank is a protocol
+                    # violation (a bug or misrouted frame — see the
+                    # threat-model note on MAX_FRAME: this does not
+                    # defend against a malicious secret-holding worker).
                     self._log_server_event(
                         f"rank-{auth_rank} connection sent a frame "
                         f"claiming rank {rank}; closing"
@@ -269,7 +287,7 @@ class ControlPlaneServer:
             with self._lock:
                 if self._log_file is not None:
                     self._log_file.write(f"[rank {rank} log_to_driver] {msg.get('text', '')}\n")
-        elif mtype == MSG_RESULT:
+        elif mtype in (MSG_RESULT, MSG_RESULT_PART, MSG_RESULT_END):
             if rank != 0:
                 # The contract returns rank 0's value only (reference
                 # runner_base.py:93-95); a RESULT from any other rank is
@@ -279,9 +297,37 @@ class ControlPlaneServer:
                     "return the job value)"
                 )
                 return
-            with self._lock:
-                self._result = payload
-                self._result_rank = rank
+            if mtype == MSG_RESULT:
+                with self._lock:
+                    self._result = payload
+                    self._result_rank = rank
+            elif mtype == MSG_RESULT_PART:
+                with self._lock:
+                    if self._result_overflow:
+                        return
+                    self._result_parts.append(payload)
+                    self._result_parts_bytes += len(payload)
+                    if self._result_parts_bytes > MAX_RESULT_TOTAL:
+                        # Bound driver memory even for the trusted path;
+                        # the job then surfaces "no result" with this
+                        # line in the job log explaining why.
+                        self._result_overflow = True
+                        self._result_parts = []
+                        self._result_parts_bytes = 0
+                if self._result_overflow:
+                    self._log_server_event(
+                        "chunked RESULT exceeded "
+                        f"{MAX_RESULT_TOTAL} bytes; discarded (raise "
+                        "SPARKDL_TPU_MAX_RESULT_BYTES if the return "
+                        "value is legitimately this large)"
+                    )
+            else:  # MSG_RESULT_END
+                with self._lock:
+                    if not self._result_overflow:
+                        self._result = b"".join(self._result_parts)
+                        self._result_rank = rank
+                    self._result_parts = []
+                    self._result_parts_bytes = 0
         elif mtype == MSG_EXC:
             msg = json.loads(payload.decode("utf-8", "replace"))
             with self._lock:
@@ -437,7 +483,16 @@ class ControlPlaneClient:
         self._send_json(MSG_USERLOG, {"text": text[:MAX_LOG_TEXT]})
 
     def send_result(self, pickled_bytes):
-        self._send(MSG_RESULT, pickled_bytes)
+        # One frame when it fits; otherwise chunk under the frame cap
+        # (large returned values — e.g. model weights — are legitimate,
+        # reference runner_base.py:93-95 puts no size bound on them).
+        if len(pickled_bytes) <= RESULT_CHUNK:
+            self._send(MSG_RESULT, pickled_bytes)
+            return
+        view = memoryview(pickled_bytes)
+        for off in range(0, len(view), RESULT_CHUNK):
+            self._send(MSG_RESULT_PART, bytes(view[off:off + RESULT_CHUNK]))
+        self._send(MSG_RESULT_END, b"")
 
     def send_exception(self, tb_text):
         # Tracebacks can embed huge reprs; keep the tail (the raise site).

@@ -36,13 +36,30 @@ logger = logging.getLogger("HorovodRunner")
 
 
 class SlotExhaustionError(RuntimeError):
-    """np exceeds available task slots (reference runner_base.py:56-58).
+    """np exceeds TOTAL task slots (reference runner_base.py:56-58).
     Never retried — more restarts cannot create slots."""
+
+
+class SlotProbeError(RuntimeError):
+    """Slot discovery itself failed (e.g. the device-count subprocess
+    died on a wedged accelerator). Surfaced instead of guessing a count:
+    an optimistic guess turns into a misleading "only N slots" error
+    at launch time. Never retried — the relaunch loop would just re-run
+    the same 120s probe against the same wedged backend."""
+
+
+class SlotWaitTimeout(RuntimeError):
+    """Gave up waiting for busy slots to free. Never retried — a
+    relaunch would silently wait the full period again right after
+    telling the user it gave up."""
 
 START_TIMEOUT_ENV = "SPARKDL_TPU_START_TIMEOUT"
 NUM_SLOTS_ENV = "SPARKDL_TPU_NUM_SLOTS"
 WORKER_PLATFORM_ENV = "SPARKDL_TPU_WORKER_PLATFORM"
+SLOT_WAIT_TIMEOUT_ENV = "SPARKDL_TPU_SLOT_WAIT_TIMEOUT"
+SLOT_DIR_ENV = "SPARKDL_TPU_SLOT_DIR"
 DEFAULT_START_TIMEOUT = 300.0
+DEFAULT_SLOT_WAIT_TIMEOUT = 600.0
 LARGE_PAYLOAD_BYTES = 10 << 20
 
 
@@ -72,40 +89,171 @@ def _probe_local_device_count(platform):
             capture_output=True, text=True, timeout=120,
         )
         return int(out.stdout.strip().splitlines()[-1])
-    except Exception:  # probe failure → optimistic single slot
-        return 1
+    except subprocess.TimeoutExpired:
+        raise SlotProbeError(
+            "slot discovery timed out after 120s probing local "
+            "accelerator devices — the backend may be wedged (set "
+            f"{NUM_SLOTS_ENV} to bypass discovery)"
+        )
+    except Exception as e:
+        detail = ""
+        if isinstance(e, (ValueError, IndexError)) and "out" in locals():
+            # Parse failure AFTER the probe ran: its stderr says why.
+            detail = f"; probe stderr tail: {out.stderr.strip()[-400:]}"
+        raise SlotProbeError(
+            f"slot discovery failed ({type(e).__name__}: {e}){detail} "
+            f"(set {NUM_SLOTS_ENV} to bypass discovery)"
+        )
 
 
 def available_slots():
     """Total task slots: override via SPARKDL_TPU_NUM_SLOTS, else the
-    number of local accelerator chips (CPU rigs: cores)."""
+    number of local accelerator chips (CPU rigs: cores). Raises
+    :class:`SlotProbeError` when discovery itself fails."""
     override = os.environ.get(NUM_SLOTS_ENV)
     if override:
         return int(override)
     return _probe_local_device_count(os.environ.get(WORKER_PLATFORM_ENV))
 
 
+# -- slot registry ----------------------------------------------------------
+#
+# The contract distinguishes BUSY slots from MISSING slots: a job whose
+# np fits the cluster total "will wait until np task slots are available
+# to launch the job", and only fails when np exceeds the total
+# (reference runner_base.py:56-58). Concurrent gangs on one host
+# coordinate through a claim-file registry: each gang atomically claims
+# its slot count under an flock'd directory, and claims of dead
+# processes are reaped so a crashed driver never leaks slots.
+
+
+def _slot_dir():
+    d = os.environ.get(SLOT_DIR_ENV) or os.path.join(
+        tempfile.gettempdir(), "sparkdl-tpu-slots"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+
+
+def _busy_slots_locked(d):
+    """Sum live claims in the registry (caller holds the lock); reaps
+    claims whose owner process is gone."""
+    busy = 0
+    for name in os.listdir(d):
+        if not name.endswith(".claim"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                pid_s, count_s = f.read().split()
+            if _pid_alive(int(pid_s)):
+                busy += int(count_s)
+            else:
+                os.unlink(path)  # stale: owner died without release
+        except (OSError, ValueError):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return busy
+
+
+class SlotClaim:
+    def __init__(self, path):
+        self._path = path
+
+    def release(self):
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+
+def claim_slots(n, total, timeout=None):
+    """Claim ``n`` of ``total`` host slots, waiting while they are busy.
+
+    Wait-until-available semantics (reference runner_base.py:56-58):
+    blocks while other live gangs hold slots, raising only on timeout
+    (``SPARKDL_TPU_SLOT_WAIT_TIMEOUT``, default 600s). The total-vs-np
+    fail-fast check happens in ``_resolve_num_workers`` before this.
+    """
+    import fcntl
+    import uuid
+
+    if timeout is None:
+        timeout = float(
+            os.environ.get(SLOT_WAIT_TIMEOUT_ENV, DEFAULT_SLOT_WAIT_TIMEOUT)
+        )
+    d = _slot_dir()
+    lock_path = os.path.join(d, ".lock")
+    deadline = time.monotonic() + timeout
+    logged_waiting = False
+    while True:
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            busy = _busy_slots_locked(d)
+            if total - busy >= n:
+                path = os.path.join(d, f"{uuid.uuid4().hex}.claim")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(f"{os.getpid()} {n}")
+                os.replace(tmp, path)
+                return SlotClaim(path)
+        if time.monotonic() > deadline:
+            raise SlotWaitTimeout(
+                f"HorovodRunner waited {timeout:.0f}s for {n} of {total} "
+                f"task slots ({busy} busy in other jobs) without success; "
+                "giving up. Increase "
+                f"{SLOT_WAIT_TIMEOUT_ENV} or stop the competing jobs."
+            )
+        if not logged_waiting:
+            logger.info(
+                "HorovodRunner: %d/%d task slots busy; waiting for %d "
+                "to free up (contract: wait while busy, fail only when "
+                "np exceeds the cluster total).", busy, total, n,
+            )
+            logged_waiting = True
+        time.sleep(0.2)
+
+
 def _resolve_num_workers(np_arg):
+    """Returns (num_workers, mode, total_slots); total_slots is None in
+    local mode (oversubscription allowed, no slot accounting). The one
+    probe here is reused for the slot claim — probing again at claim
+    time would double the 120s-budget subprocess and open a TOCTOU
+    window where a flaky probe shrinks the total below np."""
     if np_arg <= -2:
         # Local mode: spawn -np subprocesses on this host (reference
         # runner_base.py:48-53). No slot check: CPU oversubscription is
         # explicitly allowed there.
-        return -np_arg, "local"
+        return -np_arg, "local", None
     if np_arg == 0:
         logger.warning(
             "HorovodRunner(np=0) is deprecated (reference README.md:57-61); "
             "using all available task slots."
         )
-        return available_slots(), "cluster"
+        slots = available_slots()
+        return slots, "cluster", slots
     slots = available_slots()
     if np_arg > slots:
-        # Fail fast (reference runner_base.py:56-58).
+        # np exceeds the cluster TOTAL: fail fast, never wait
+        # (reference runner_base.py:56-58).
         raise SlotExhaustionError(
-            f"HorovodRunner requested np={np_arg} task slots but only "
-            f"{slots} are available; the job fails fast rather than wait "
-            "(set SPARKDL_TPU_NUM_SLOTS to override slot discovery)."
+            f"HorovodRunner requested np={np_arg} task slots but the host "
+            f"has only {slots} in total; the job fails fast rather than "
+            "wait (set SPARKDL_TPU_NUM_SLOTS to override slot discovery)."
         )
-    return np_arg, "cluster"
+    return np_arg, "cluster", slots
 
 
 def _worker_env(base_env, *, rank, size, coordinator, control_addr,
@@ -177,8 +325,8 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
             return _launch_gang_once(
                 np, main, kwargs, driver_log_verbosity, per_rank_kwargs
             )
-        except SlotExhaustionError:
-            raise  # typed, never retryable
+        except (SlotExhaustionError, SlotProbeError, SlotWaitTimeout):
+            raise  # typed, never retryable (cannot self-heal)
         except RuntimeError as e:
             if attempt >= max_restarts:
                 raise
@@ -196,7 +344,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
 
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
 
-    num_workers, mode = _resolve_num_workers(np)
+    num_workers, mode, total_slots = _resolve_num_workers(np)
     if per_rank_kwargs is not None and len(per_rank_kwargs) != num_workers:
         raise ValueError(
             f"per_rank_kwargs has {len(per_rank_kwargs)} entries for a "
@@ -218,60 +366,73 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         except ImportError:
             pass
 
-    job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-job-")
-    payload_paths = []
-    for r in range(num_workers):
-        rank_kwargs = dict(kwargs)
-        if per_rank_kwargs is not None:
-            rank_kwargs.update(per_rank_kwargs[r])
-        payload = cloudpickle.dumps((main, rank_kwargs))
-        if r == 0 and len(payload) > LARGE_PAYLOAD_BYTES:
-            # Contract: pickling a large main slows job start (reference
-            # runner_base.py:90-91).
-            logger.warning(
-                "Pickled main + kwargs is %.1f MB; large closures make "
-                "HorovodRunner jobs slow to start. Move data loading "
-                "inside main().", len(payload) / 2**20,
-            )
-        path = os.path.join(job_dir, f"payload-{r}.pkl")
-        with open(path, "wb") as f:
-            f.write(payload)
-        payload_paths.append(path)
-        if per_rank_kwargs is None:
-            # identical payload for everyone: write once, share
-            payload_paths = [path] * num_workers
-            break
-
-    # Prebuild the native log transport once on the driver so workers
-    # don't each pay (or race) the compile inside the gang start
-    # timeout; workers then dlopen the cached .so.
-    try:
-        from sparkdl_tpu.native import load_ctrl_lib
-
-        load_ctrl_lib()
-    except Exception:  # pragma: no cover - never block launch on this
-        pass
-
-    # Local subprocess mode streams training stdout/stderr to the
-    # driver unconditionally (reference README.md:44-47: "Training
-    # stdout and stderr messages go to the notebook cell output");
-    # cluster mode honors driver_log_verbosity (runner_base.py:62-72).
-    effective_verbosity = "all" if mode == "local" else driver_log_verbosity
-    server = ControlPlaneServer(
-        num_workers,
-        verbosity=effective_verbosity,
-        log_path=os.path.join(job_dir, "job.log"),
-    )
-    coordinator = f"127.0.0.1:{_free_port()}"
-    platform = os.environ.get(WORKER_PLATFORM_ENV)
-
-    logger.info(
-        "Launching HorovodRunner gang: %d worker(s), mode=%s, job_dir=%s",
-        num_workers, mode, job_dir,
-    )
+    # Cluster gangs on this host share a slot registry: wait while
+    # another job's gang holds slots, launch when ours free up
+    # (reference runner_base.py:56-58 — waiting is the contract;
+    # np > total already failed fast above, using the same probe).
+    # Local mode (np<-1) deliberately skips this: oversubscription is
+    # allowed there. ONE try/finally owns every resource from here —
+    # a leaked claim counts as busy for this driver's whole lifetime.
+    slot_claim = None
+    if mode == "cluster":
+        slot_claim = claim_slots(num_workers, total_slots)
+    server = None
     procs = []
     boot_logs = []
     try:
+        job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-job-")
+        payload_paths = []
+        for r in range(num_workers):
+            rank_kwargs = dict(kwargs)
+            if per_rank_kwargs is not None:
+                rank_kwargs.update(per_rank_kwargs[r])
+            payload = cloudpickle.dumps((main, rank_kwargs))
+            if r == 0 and len(payload) > LARGE_PAYLOAD_BYTES:
+                # Contract: pickling a large main slows job start
+                # (reference runner_base.py:90-91).
+                logger.warning(
+                    "Pickled main + kwargs is %.1f MB; large closures make "
+                    "HorovodRunner jobs slow to start. Move data loading "
+                    "inside main().", len(payload) / 2**20,
+                )
+            path = os.path.join(job_dir, f"payload-{r}.pkl")
+            with open(path, "wb") as f:
+                f.write(payload)
+            payload_paths.append(path)
+            if per_rank_kwargs is None:
+                # identical payload for everyone: write once, share
+                payload_paths = [path] * num_workers
+                break
+
+        # Prebuild the native log transport once on the driver so
+        # workers don't each pay (or race) the compile inside the gang
+        # start timeout; workers then dlopen the cached .so.
+        try:
+            from sparkdl_tpu.native import load_ctrl_lib
+
+            load_ctrl_lib()
+        except Exception:  # pragma: no cover - never block launch on this
+            pass
+
+        # Local subprocess mode streams training stdout/stderr to the
+        # driver unconditionally (reference README.md:44-47: "Training
+        # stdout and stderr messages go to the notebook cell output");
+        # cluster mode honors driver_log_verbosity (runner_base.py:62-72).
+        effective_verbosity = (
+            "all" if mode == "local" else driver_log_verbosity
+        )
+        server = ControlPlaneServer(
+            num_workers,
+            verbosity=effective_verbosity,
+            log_path=os.path.join(job_dir, "job.log"),
+        )
+        coordinator = f"127.0.0.1:{_free_port()}"
+        platform = os.environ.get(WORKER_PLATFORM_ENV)
+
+        logger.info(
+            "Launching HorovodRunner gang: %d worker(s), mode=%s, job_dir=%s",
+            num_workers, mode, job_dir,
+        )
         for r in range(num_workers):
             env = _worker_env(
                 os.environ, rank=r, size=num_workers,
@@ -394,4 +555,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 f.close()
             except OSError:
                 pass
-        server.close()
+        if server is not None:
+            server.close()
+        if slot_claim is not None:
+            slot_claim.release()

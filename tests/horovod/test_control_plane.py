@@ -154,6 +154,30 @@ def test_oversized_frame_closes_connection(server):
     s.close()
 
 
+def test_large_result_is_chunked_and_reassembled(server, monkeypatch):
+    from sparkdl_tpu.horovod import control_plane
+
+    # Shrink the chunk size so the test doesn't shuffle 32 MiB around.
+    monkeypatch.setattr(control_plane, "RESULT_CHUNK", 1024)
+    c0 = ControlPlaneClient(server.address, rank=0, secret=server.secret)
+    blob = bytes(range(256)) * 40  # 10240 bytes → 10 chunks
+    c0.send_result(blob)
+    _drain(server)
+    assert server.result_bytes == blob
+    c0.close()
+
+
+def test_chunked_result_from_nonzero_rank_ignored(server, monkeypatch):
+    from sparkdl_tpu.horovod import control_plane
+
+    monkeypatch.setattr(control_plane, "RESULT_CHUNK", 1024)
+    c1 = ControlPlaneClient(server.address, rank=1, secret=server.secret)
+    c1.send_result(b"z" * 5000)
+    _drain(server)
+    assert server.result_bytes is None
+    c1.close()
+
+
 def test_client_refuses_to_run_without_secret(server, monkeypatch):
     from sparkdl_tpu.horovod.control_plane import CONTROL_SECRET_ENV
 
