@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""KV-cache decode throughput (tokens/sec) for the serving path: one
+prefill + one scanned decode program (models/generate.py). Prints one
+JSON line. Run on a TPU host; SPARKDL_TPU_BENCH_TINY=1 for a CPU smoke.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparkdl_tpu.models import Llama, LlamaConfig
+    from sparkdl_tpu.models.generate import generate
+
+    if os.environ.get("SPARKDL_TPU_BENCH_TINY"):
+        cfg = LlamaConfig.tiny(max_cache_len=128)
+        batch, p_len, new = 2, 16, 32
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16,
+            max_cache_len=2048,
+        )
+        batch, p_len, new = 8, 128, 512
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, p_len)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    # Warm (compiles prefill + decode_loop once).
+    out = generate(model, params, prompt, max_new_tokens=new)
+    np.asarray(out)
+
+    t0 = time.perf_counter()
+    out = generate(model, params, prompt, max_new_tokens=new)
+    np.asarray(out)  # host readback = true sync
+    dt = time.perf_counter() - t0
+    tps = batch * new / dt
+    print(json.dumps({
+        "metric": "llama_decode_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "batch": batch, "prompt_len": p_len, "new_tokens": new,
+        "platform": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -109,12 +109,27 @@ class BinaryClassificationEvaluator(_Evaluator):
             return float(
                 (r_pos - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
             )
-        # areaUnderPR: average precision over descending thresholds
+        # areaUnderPR per pyspark's BinaryClassificationMetrics: the PR
+        # curve has one point per DISTINCT threshold (ties grouped),
+        # prepended with (recall=0, precision of the first point), and
+        # the area is the trapezoidal (linear) integral — average
+        # precision would diverge from pyspark on small/tied data.
         order = np.argsort(-scores, kind="mergesort")
         y_sorted = y[order]
+        s_sorted = scores[order]
         tp = np.cumsum(y_sorted == 1)
-        precision = tp / np.arange(1, len(y) + 1)
-        return float((precision * (y_sorted == 1)).sum() / n_pos)
+        n = len(y)
+        # last index of each tied-score group = the curve's points
+        boundary = np.nonzero(
+            np.append(s_sorted[1:] != s_sorted[:-1], True)
+        )[0]
+        tp_b = tp[boundary]
+        recall = tp_b / n_pos
+        precision = tp_b / (boundary + 1.0)
+        recall = np.concatenate([[0.0], recall])
+        precision = np.concatenate([[precision[0]], precision])
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # np<2.0
+        return float(trapezoid(precision, recall))
 
 
 class RegressionEvaluator(_Evaluator):

@@ -1,11 +1,65 @@
 """Autoregressive generation for the Llama decoder: prefill + cached
-decode, both jitted once (static shapes), greedy or temperature
-sampling. Serving-side counterpart to the training path."""
+decode, greedy or temperature sampling. Serving-side counterpart to the
+training path.
+
+TPU-first design: the whole decode loop is ONE jitted program
+(``lax.scan`` over steps) — per-token Python dispatch would pay a
+host→device round trip per generated token (~25 ms on remote-tunnel
+devices, dwarfing the step itself). The jitted programs are cached
+process-wide per (decode-config, temperature), so a serving loop
+compiles on the first request only; jit's own static-argument cache
+covers varying ``max_new_tokens``.
+"""
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_programs(dec_cfg, temperature):
+    """(prefill, decode_loop) jitted for one decode config. Cached so a
+    second generate() call with the same config compiles nothing."""
+    from sparkdl_tpu.models.llama import Llama
+
+    dec_model = Llama(dec_cfg)
+
+    def _next_token(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def prefill(params, tokens, rng):
+        logits, state = dec_model.apply(
+            {"params": params}, tokens, mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        token = _next_token(logits[:, -1], sub)
+        return state["cache"], token, rng
+
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def decode_loop(params, cache, token, rng, n_steps):
+        def body(carry, _):
+            cache, token, rng = carry
+            logits, state = dec_model.apply(
+                {"params": params, "cache": cache}, token[:, None],
+                mutable=["cache"],
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _next_token(logits[:, -1], sub)
+            return (state["cache"], nxt, rng), nxt
+
+        (cache, token, rng), toks = jax.lax.scan(
+            body, (cache, token, rng), None, length=n_steps
+        )
+        return cache, toks  # toks: (n_steps, batch)
+
+    return prefill, decode_loop
 
 
 def generate(model, params, prompt_tokens, *, max_new_tokens=32,
@@ -15,10 +69,9 @@ def generate(model, params, prompt_tokens, *, max_new_tokens=32,
     :param model: a Llama (training or decode config — a decode-mode
         twin is derived automatically; params are shared).
     :param prompt_tokens: (batch, prompt_len) int32.
-    :return: (batch, prompt_len + max_new_tokens) tokens.
+    :return: (batch, prompt_len + n) tokens, n <= max_new_tokens
+        (shorter when every row has emitted ``eos_id``).
     """
-    from sparkdl_tpu.models.llama import Llama
-
     prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
     b, p_len = prompt_tokens.shape
     cfg = model.cfg
@@ -28,49 +81,35 @@ def generate(model, params, prompt_tokens, *, max_new_tokens=32,
             f"exceeds max_cache_len ({cfg.max_cache_len}); raise "
             "LlamaConfig.max_cache_len"
         )
-    dec_model = (
-        model if cfg.decode
-        else Llama(dataclasses.replace(cfg, decode=True))
-    )
+    dec_cfg = dataclasses.replace(cfg, decode=True)
+    prefill, decode_loop = _decode_programs(dec_cfg, float(temperature))
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    @jax.jit
-    def prefill(params, tokens):
-        logits, state = dec_model.apply(
-            {"params": params}, tokens, mutable=["cache"],
+    cache, token, rng = prefill(params, prompt_tokens, rng)
+    if max_new_tokens > 1:
+        _, scanned = decode_loop(
+            params, cache, token, rng, max_new_tokens - 1
         )
-        return logits[:, -1], state["cache"]
-
-    @jax.jit
-    def decode_step(params, cache, token, rng):
-        logits, state = dec_model.apply(
-            {"params": params, "cache": cache}, token[:, None],
-            mutable=["cache"],
-        )
-        logits = logits[:, -1]
-        rng, sub = jax.random.split(rng)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1)
-        else:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-        return state["cache"], nxt.astype(jnp.int32), rng
-
-    last_logits, cache = prefill(params, prompt_tokens)
-    if temperature == 0.0:
-        token = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        new_tokens = jnp.concatenate(
+            [token[:, None], scanned.T], axis=1
+        )  # (b, max_new_tokens)
     else:
-        rng, sub = jax.random.split(rng)
-        token = jax.random.categorical(
-            sub, last_logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        new_tokens = token[:, None]
 
-    out = [token]
-    for _ in range(max_new_tokens - 1):
-        cache, token, rng = decode_step(params, cache, token, rng)
-        out.append(token)
-        if eos_id is not None and bool((token == eos_id).all()):
-            break
-    return jnp.concatenate(
-        [prompt_tokens] + [t[:, None] for t in out], axis=1
-    )
+    if eos_id is not None:
+        # Early-stop semantics of a step-by-step loop: truncate after
+        # the first LOOP step where every row emitted eos. The prefill
+        # token (column 0) is exempt — the loop formulation only checks
+        # tokens its body generates. Tokens before the cut are
+        # identical either way (decoding is causal and the per-step
+        # rng split order is fixed), so scanning the full length and
+        # trimming is observationally equivalent.
+        import numpy as np
+
+        all_eos = np.asarray((new_tokens[:, 1:] == eos_id).all(axis=0))
+        hits = np.flatnonzero(all_eos)
+        if hits.size:
+            new_tokens = new_tokens[:, :int(hits[0]) + 2]
+
+    return jnp.concatenate([prompt_tokens, new_tokens], axis=1)

@@ -49,8 +49,11 @@ def _flash_core_bwd(causal, scale, block, interpret, res, do):
     )
 
     q, k, v, o, lse = res
+    # keepdims: lse/delta ride (B, H, S, 1) blocks (TPU tiling, see
+    # flash_attention_bhsd docstring).
     delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+        keepdims=True,
     )
     dq, dk, dv = flash_attention_bwd_bhsd(
         q, k, v, do, lse, delta, causal=causal, scale=scale,

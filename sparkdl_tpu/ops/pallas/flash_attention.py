@@ -91,8 +91,13 @@ def _make_kernel(bq, bk, seq_len, causal, scale, with_lse=False):
         out = acc / jnp.maximum(l, 1e-30)[:, None]
         o_ref[0, 0] = out.astype(o_ref.dtype)
         if with_lse:
-            # logsumexp per row: softmax probs are exp(s - lse) in bwd
-            maybe_lse[0][0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+            # logsumexp per row: softmax probs are exp(s - lse) in bwd.
+            # Carried as (..., bq, 1): TPU tiling requires the last two
+            # block dims to be (mult of 8, mult of 128 | full dim) — a
+            # rank-3 (1, 1, bq) block violates that on real hardware.
+            maybe_lse[0][0, 0] = (
+                m + jnp.log(jnp.maximum(l, 1e-30))
+            )[:, None]
 
     return kernel
 
@@ -103,7 +108,8 @@ def flash_attention_bhsd(q, k, v, *, causal=True, scale=None, bq=128,
 
     seq must be divisible by the block sizes (the public wrapper in
     :mod:`sparkdl_tpu.ops.attention` pads). With ``return_lse`` also
-    returns the per-row logsumexp (B, H, S) for the fused backward.
+    returns the per-row logsumexp (B, H, S, 1) for the fused backward
+    (trailing singleton: see the tiling note in the kernel).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -119,12 +125,14 @@ def flash_attention_bhsd(q, k, v, *, causal=True, scale=None, bq=128,
     grid = (b, h, s // bq)
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
     kv_spec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    lse_spec = pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i))
+    lse_spec = pl.BlockSpec(
+        (1, 1, bq, 1), lambda bi, hi, i: (bi, hi, i, 0)
+    )
     out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
     if return_lse:
         out_shape = (
             out_shape,
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         )
     out = pl.pallas_call(
         kernel,
@@ -149,8 +157,8 @@ def _make_dq_kernel(bq, bk, seq_len, causal, scale):
         qi = pl.program_id(2)
         q = q_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]                                 # (bq,)
-        delta = delta_ref[0, 0]                             # (bq,)
+        lse = lse_ref[0, 0, :, 0]                           # (bq,)
+        delta = delta_ref[0, 0, :, 0]                       # (bq,)
 
         def body(j, dq):
             kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
@@ -201,8 +209,8 @@ def _make_dkv_kernel(bq, bk, seq_len, causal, scale):
             dk, dv = carry
             qb = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
             dob = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-            lse = lse_ref[0, 0, pl.ds(i * bq, bq)]
-            delta = delta_ref[0, 0, pl.ds(i * bq, bq)]
+            lse = lse_ref[0, 0, pl.ds(i * bq, bq), 0]
+            delta = delta_ref[0, 0, pl.ds(i * bq, bq), 0]
             s_ij = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -240,7 +248,8 @@ def _make_dkv_kernel(bq, bk, seq_len, causal, scale):
 def flash_attention_bwd_bhsd(q, k, v, do, lse, delta, *, causal=True,
                              scale=None, bq=128, bk=128, interpret=False):
     """Fused backward: (dq, dk, dv) from saved (q, k, v, lse) and the
-    output-gradient rowsum delta = sum(do * o, -1)."""
+    output-gradient rowsum delta = sum(do * o, -1, keepdims=True); lse
+    and delta are (B, H, S, 1) per the forward's tiling note."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -254,8 +263,12 @@ def flash_attention_bwd_bhsd(q, k, v, do, lse, delta, *, causal=True,
     q_tile = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
     k_tile = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, i: (bi, hi, i, 0))
     full_s = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    vec_q = pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i))
-    vec_full = pl.BlockSpec((1, 1, s), lambda bi, hi, i: (bi, hi, 0))
+    vec_q = pl.BlockSpec(
+        (1, 1, bq, 1), lambda bi, hi, i: (bi, hi, i, 0)
+    )
+    vec_full = pl.BlockSpec(
+        (1, 1, s, 1), lambda bi, hi, i: (bi, hi, 0, 0)
+    )
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel"),
     )

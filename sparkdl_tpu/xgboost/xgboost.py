@@ -304,11 +304,47 @@ def _fit_booster(params, X, y, w, base_margin, X_val, y_val,
         for s in shards
     ]
 
-    from sparkdl_tpu.horovod.launcher import available_slots, launch_gang
+    from sparkdl_tpu.horovod.launcher import (
+        SlotProbeError,
+        available_slots,
+        launch_gang,
+    )
 
     # One boosting worker per task slot (reference xgboost.py:58-64):
     # cluster gang when slots exist, local subprocess gang otherwise.
-    np_arg = num_workers if available_slots() >= num_workers else -num_workers
+    # The fallback oversubscribes the host, so it is never silent —
+    # and SPARKDL_TPU_XGB_STRICT_SLOTS=1 turns it into the same
+    # fail-fast HorovodRunner(np>0) applies.
+    strict = os.environ.get("SPARKDL_TPU_XGB_STRICT_SLOTS") == "1"
+    try:
+        slots = available_slots()
+    except SlotProbeError as e:
+        if strict:
+            raise
+        logger.warning(
+            "xgboost: slot discovery failed (%s); falling back to %d "
+            "local subprocess workers.", e, num_workers,
+        )
+        np_arg = -num_workers
+    else:
+        if slots >= num_workers:
+            np_arg = num_workers
+        elif strict:
+            raise RuntimeError(
+                f"num_workers={num_workers} exceeds the {slots} available "
+                "task slots and SPARKDL_TPU_XGB_STRICT_SLOTS=1 forbids "
+                "the oversubscribed local fallback (reference "
+                "xgboost.py:58-64)."
+            )
+        else:
+            logger.warning(
+                "num_workers=%d exceeds the %d available task slots; "
+                "training falls back to %d OVERSUBSCRIBED local "
+                "subprocess workers (slower, same result). Set "
+                "SPARKDL_TPU_XGB_STRICT_SLOTS=1 to fail fast instead.",
+                num_workers, slots, num_workers,
+            )
+            np_arg = -num_workers
     return launch_gang(
         np=np_arg, main=gang_main,
         kwargs=dict(

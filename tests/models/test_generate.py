@@ -85,3 +85,41 @@ def test_sampled_generation_runs():
     assert out.shape == (2, 12)
     assert (np.asarray(out) >= 0).all()
     assert (np.asarray(out) < cfg.vocab_size).all()
+
+
+def test_second_generate_call_compiles_nothing(caplog):
+    """The decode programs are cached per (config, temperature): a
+    serving loop must pay XLA compilation on the first request only."""
+    import logging
+
+    cfg, model, params, prompt = _setup()
+    # Warm: first call may compile prefill + decode_loop.
+    generate(model, params, prompt, max_new_tokens=5)
+    with jax.log_compiles(True):
+        with caplog.at_level(logging.WARNING):
+            out = generate(model, params, prompt, max_new_tokens=5)
+    assert out.shape == (2, 13)
+    compiles = [r for r in caplog.records if "Compiling" in r.getMessage()]
+    assert not compiles, [r.getMessage()[:120] for r in compiles]
+
+
+def test_eos_truncates_when_all_rows_finish():
+    """When every row emits eos at the same step, the output stops
+    right after it (step-loop early-exit semantics, scan + trim impl)."""
+    import pytest
+
+    cfg, model, params, prompt = _setup()
+    full = generate(model, params, prompt, max_new_tokens=6)
+    # Pick a token every row generates at the same post-prefill step as
+    # the "eos": the output must then end at that step.
+    gen = np.asarray(full[:, prompt.shape[1]:])
+    shared = [
+        j for j in range(1, gen.shape[1] - 1)
+        if (gen[:, j] == gen[0, j]).all()
+    ]
+    if not shared:
+        pytest.skip("untrained model generated no batch-shared token")
+    j = shared[0]
+    out = generate(model, params, prompt, max_new_tokens=6,
+                   eos_id=int(gen[0, j]))
+    assert out.shape[1] <= prompt.shape[1] + j + 1

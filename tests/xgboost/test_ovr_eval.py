@@ -95,3 +95,34 @@ def test_binary_evaluator_pr_and_validation():
     assert pr > 0.9
     with pytest.raises(ValueError, match="metricName"):
         BinaryClassificationEvaluator(metricName="logLoss").evaluate(out)
+
+
+def test_area_under_pr_matches_pyspark_interpolation():
+    """areaUnderPR is Spark's trapezoidal PR-curve integral — one point
+    per distinct threshold, (0, p_first) prepended — not average
+    precision (the two diverge on exactly this dataset)."""
+    ev = BinaryClassificationEvaluator(metricName="areaUnderPR")
+    df = pd.DataFrame({
+        "label": [1.0, 0.0, 1.0, 0.0],
+        "rawPrediction": [
+            [0.0, 0.9], [0.0, 0.8], [0.0, 0.7], [0.0, 0.1],
+        ],
+    })
+    # Curve points (recall, precision) at thresholds .9/.8/.7/.1:
+    #   (1/2, 1/1), (1/2, 1/2), (1, 2/3), (1, 2/4); prepend (0, 1).
+    # Trapezoid: .5*(1+1)/2 + 0 + .5*(1/2+2/3)/2 + 0 = 0.7916667
+    expected = 0.5 * 1.0 + 0.5 * (0.5 + 2 / 3) / 2
+    assert abs(ev.evaluate(df) - expected) < 1e-9
+    # average precision would give (1 + 2/3)/2 = 0.8333... — different.
+    assert abs(ev.evaluate(df) - (1 + 2 / 3) / 2) > 0.03
+
+
+def test_area_under_pr_tied_scores_grouped():
+    """All-tied scores form ONE curve point (recall 1, precision =
+    base rate); with (0, p) prepended the area is the base rate."""
+    ev = BinaryClassificationEvaluator(metricName="areaUnderPR")
+    df = pd.DataFrame({
+        "label": [0.0, 1.0, 0.0, 1.0],
+        "rawPrediction": [[0.0, 1.0]] * 4,
+    })
+    assert abs(ev.evaluate(df) - 0.5) < 1e-9
