@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""MNIST Keras CNN on the JAX backend under HorovodRunner — the same
+main as examples/tf_keras_mnist.py, but with ``KERAS_BACKEND=jax`` the
+whole forward/backward runs in XLA ON the TPU chip (reference
+``runner_base.py:44-45``: one task slot = one accelerator doing the
+work), instead of TF host compute with bridged collectives.
+
+Run locally:          python examples/keras3_jax_mnist.py
+Local 4-process gang: python examples/keras3_jax_mnist.py -4
+Cluster gang:         python examples/keras3_jax_mnist.py 8
+
+For a single process driving a whole TPU slice, skip HorovodRunner and
+call ``horovod.keras.init_distribution()`` instead — model.fit then
+shards the batch over every chip with in-graph GSPMD collectives.
+"""
+
+import sys
+
+from sparkdl import HorovodRunner
+
+
+def train_hvd(learning_rate=0.05, epochs=2):
+    import os
+
+    os.environ["KERAS_BACKEND"] = "jax"  # before the keras import
+
+    import numpy as np
+
+    import horovod.keras as hvd
+    import keras
+
+    hvd.init()
+
+    # synthetic MNIST-shaped data so the example runs offline; swap in
+    # keras.datasets.mnist.load_data() when you have the real thing
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(2048, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, 2048)
+
+    model = keras.Sequential([
+        keras.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    # Horovod recipe: scale LR by gang size, wrap the optimizer,
+    # broadcast initial state from rank 0.
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate * hvd.size())
+    )
+    model.compile(
+        optimizer=opt,
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+    hist = model.fit(
+        x, y, batch_size=64, epochs=epochs, verbose=0,
+        callbacks=[
+            hvd.BroadcastGlobalVariablesCallback(0),
+            hvd.LogCallback(),
+        ],
+    )
+    if hvd.rank() == 0:
+        return {"loss": hist.history["loss"],
+                "backend": keras.backend.backend()}
+
+
+if __name__ == "__main__":
+    np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -1
+    out = HorovodRunner(np=np_arg).run(train_hvd)
+    print("rank-0 result:", out)

@@ -1,4 +1,281 @@
-# ``horovod.keras`` is an alias of ``horovod.tensorflow.keras`` (as in
-# upstream Horovod, where it wraps the standalone keras package).
-from horovod.tensorflow.keras import *  # noqa: F401,F403
-from horovod.tensorflow.keras import callbacks  # noqa: F401
+"""``horovod.keras`` shim for Keras 3 — backend-aware, JAX-first.
+
+Upstream Horovod's ``horovod.keras`` wraps the standalone keras
+package; this shim does the same for Keras 3, where ``model.fit`` can
+run its whole train step in XLA on the TPU via ``KERAS_BACKEND=jax``
+(the route to reference-parity samples/sec/chip for keras mains —
+reference ``runner_base.py:44-45``: one task slot = one accelerator
+doing the work). Unlike ``horovod.tensorflow.keras`` this module never
+imports tensorflow, so a jax-backend main stays tf-free.
+
+Gradient crossing tiers, fastest first:
+
+1. **keras.distribution set** (SPMD): gradients of replicated params
+   are already psum'd in-graph by GSPMD — DistributedOptimizer becomes
+   a no-op passthrough.
+2. **Concrete jax grads** (custom training loops): zero-host-copy
+   device collective (``_CollectiveEngine.reduce_jax``) — tensors
+   never leave the chip.
+3. **Traced jax grads** (unmodified ``model.fit`` without a keras
+   distribution): the allreduce enters the jitted train step as ONE
+   ``jax.pure_callback`` per dtype group — a single host hop per step,
+   with concat/split staying on device.
+4. **tensorflow / torch backends**: numpy bridge via the hvd shim.
+"""
+
+import numpy as np
+
+import sparkdl_tpu.hvd as hvd
+from sparkdl_tpu.hvd import (  # noqa: F401
+    Average,
+    Compression,
+    Max,
+    Min,
+    Sum,
+    _resolve_op,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    broadcast_object,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def _keras():
+    import keras
+
+    return keras
+
+
+def _distribution_active():
+    keras = _keras()
+    try:
+        return keras.distribution.distribution() is not None
+    except AttributeError:  # pragma: no cover - very old keras
+        return False
+
+
+def _allreduce_traced_jax(grads, kind):
+    """Allreduce TRACED jax gradients (inside keras's jitted train
+    step): ONE pure_callback carrying every dtype group calls the gang
+    collectives on host; concat/split bookkeeping stays in-graph.
+
+    A single callback node is load-bearing: independent callbacks have
+    no guaranteed execution order, so per-group callbacks could enter
+    the gang collectives in different orders on different ranks
+    (mismatched programs -> deadlock). One callback = one ordering
+    point; inside it the per-group reduces run in list order on every
+    rank."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.hvd._collectives import engine
+
+    by_dtype = {}
+    for i, g in enumerate(grads):
+        by_dtype.setdefault(jnp.dtype(g.dtype), []).append(i)
+    groups = list(by_dtype.values())  # deterministic insertion order
+    flats = [
+        jnp.concatenate([grads[i].ravel() for i in idxs])
+        if len(idxs) > 1 else grads[idxs[0]].ravel()
+        for idxs in groups
+    ]
+
+    def _host_reduce_all(flat_list, _kind=kind):
+        return tuple(
+            engine().reduce(np.asarray(a, order="C"), _kind)
+            for a in flat_list
+        )
+
+    reduced_flats = jax.pure_callback(
+        _host_reduce_all,
+        tuple(jax.ShapeDtypeStruct(f.shape, f.dtype) for f in flats),
+        flats,
+    )
+    out = list(grads)
+    for idxs, red in zip(groups, reduced_flats):
+        offset = 0
+        for i in idxs:
+            n = int(np.prod(grads[i].shape)) if grads[i].shape else 1
+            out[i] = red[offset:offset + n].reshape(grads[i].shape)
+            offset += n
+    return out
+
+
+def _allreduce_grads(grads, kind):
+    keras = _keras()
+    live = [(i, g) for i, g in enumerate(grads) if g is not None]
+    if not live or hvd.size() == 1:
+        return grads
+    if _distribution_active():
+        # SPMD (keras.distribution): GSPMD already reduces gradients of
+        # replicated variables in-graph; reducing again would double it.
+        return grads
+    out = list(grads)
+    vals = [g for _, g in live]
+    if keras.backend.backend() == "jax":
+        import jax
+
+        if any(isinstance(g, jax.core.Tracer) for g in vals):
+            reduced = _allreduce_traced_jax(vals, kind)
+        else:
+            reduced = hvd.grouped_allreduce(vals, op=kind)
+    elif keras.backend.backend() == "tensorflow":
+        # tf-backend fit() hands apply() SYMBOLIC tensors inside a
+        # tf.function; the tf shim's py_function bridge handles both
+        # graph and eager tensors.
+        from horovod.tensorflow import grouped_allreduce as tf_grouped
+
+        reduced = tf_grouped(vals, op=kind)
+    else:
+        reduced = hvd.grouped_allreduce(vals, op=kind)
+    for (i, _), r in zip(live, reduced):
+        out[i] = r
+    return out
+
+
+def DistributedOptimizer(optimizer, name=None, compression=None,
+                         op=None, average=None, **kwargs):
+    """Wrap a Keras 3 optimizer so gradients are allreduced across the
+    gang before application (Horovod semantics: average by default, so
+    the effective batch is np x the per-worker batch).
+
+    Hooks ``apply`` — which Keras 3 routes BOTH eager custom-loop calls
+    and the jitted ``model.fit`` train step through (``stateless_apply``
+    calls ``apply`` inside its stateless scope).
+
+    Serialization caveat: the wrapper is a dynamic subclass, so a saved
+    model records the wrapped class name; load with the base optimizer
+    and re-wrap (same guidance as upstream Horovod)."""
+    del name, kwargs
+    if compression is not None and compression is not Compression.none:
+        import logging
+
+        logging.getLogger("sparkdl.horovod").warning(
+            "horovod.keras.DistributedOptimizer: gradient compression "
+            "is not applied on the keras-3 path (gradients cross the "
+            "gang at their native dtype); ignoring compression=%r.",
+            compression,
+        )
+    kind = _resolve_op(average, op)
+    cls = optimizer.__class__
+
+    class _DistributedOptimizer(cls):
+        _hvd_op = kind
+
+        def apply(self, grads, trainable_variables=None):
+            grads = _allreduce_grads(list(grads), self._hvd_op)
+            return super().apply(grads, trainable_variables)
+
+    _DistributedOptimizer.__name__ = "Distributed" + cls.__name__
+    optimizer.__class__ = _DistributedOptimizer
+    return optimizer
+
+
+def broadcast_model_variables(model, root_rank=0):
+    """Synchronize every model (and built optimizer) variable to
+    ``root_rank``'s values — horovod's broadcast_variables for Keras 3
+    (determinism contract, SURVEY.md §5.2). All values ship in ONE
+    fused broadcast_object (a per-variable collective would compile a
+    fresh program per shape and stall the first step on big models)."""
+    variables = list(model.variables)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "built", False):
+        variables += list(opt.variables)
+    if hvd.size() == 1 or not variables:
+        return
+    values = (
+        [np.asarray(v) for v in variables] if hvd.rank() == root_rank
+        else None
+    )
+    values = hvd.broadcast_object(values, root_rank)
+    for v, val in zip(variables, values):
+        v.assign(val)
+
+
+class LogCallback:
+    """Keras-3 LogCallback: streams epoch/batch progress over the
+    worker->driver channel (same contract as
+    :class:`sparkdl_tpu.horovod.tensorflow.keras.LogCallback`, without
+    importing tensorflow)."""
+
+    def __new__(cls, per_batch_log=False):
+        import time
+
+        keras = _keras()
+
+        from sparkdl_tpu.horovod import log_to_driver
+
+        def _fmt(logs):
+            if not logs:
+                return ""
+            return " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in logs.items()
+            )
+
+        class _Callback(keras.callbacks.Callback):
+            def __init__(self, per_batch):
+                super().__init__()
+                self.per_batch_log = per_batch
+                self._epoch = None
+                self._t0 = None
+
+            def on_epoch_begin(self, epoch, logs=None):
+                self._epoch = epoch
+                self._t0 = time.time()
+                log_to_driver(
+                    f"Epoch {epoch} begin at "
+                    f"{time.strftime('%Y-%m-%d %H:%M:%S')}"
+                )
+
+            def on_batch_end(self, batch, logs=None):
+                if self.per_batch_log:
+                    log_to_driver(
+                        f"Epoch {self._epoch} batch {batch}: {_fmt(logs)}"
+                    )
+
+            def on_epoch_end(self, epoch, logs=None):
+                dt = time.time() - (self._t0 or time.time())
+                log_to_driver(f"Epoch {epoch} end ({dt:.1f}s): {_fmt(logs)}")
+
+        return _Callback(per_batch_log)
+
+
+def init_distribution():
+    """Enable Keras 3's native SPMD data parallelism (in-graph GSPMD
+    collectives over every chip jax can see — all hosts of the gang
+    once ``hvd.init()`` has run ``jax.distributed.initialize``).
+
+    With a distribution set, ``model.fit`` shards the batch over the
+    mesh and XLA inserts the gradient psum — no host hop anywhere.
+    DistributedOptimizer detects this and becomes a passthrough, so a
+    horovod-style main gains the fully in-graph path by adding one
+    call."""
+    keras = _keras()
+    dp = keras.distribution.DataParallel()
+    keras.distribution.set_distribution(dp)
+    return dp
+
+
+# Submodule import LAST: callbacks.py reads names defined above.
+from horovod.keras import callbacks  # noqa: E402,F401
+from horovod.keras.callbacks import (  # noqa: E402,F401
+    BroadcastGlobalVariablesCallback,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "allreduce", "allgather", "broadcast",
+    "broadcast_object", "barrier", "DistributedOptimizer",
+    "broadcast_model_variables", "BroadcastGlobalVariablesCallback",
+    "LogCallback", "init_distribution", "callbacks", "Average", "Sum",
+    "Min", "Max", "Compression",
+]

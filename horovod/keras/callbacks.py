@@ -1,0 +1,122 @@
+"""Horovod keras callbacks for Keras 3 (any backend, tf-free).
+
+The upstream ``hvd.callbacks.*`` surface existing mains use:
+``BroadcastGlobalVariablesCallback`` (parameter determinism at train
+start), ``MetricAverageCallback`` (epoch metrics averaged over the
+gang), ``LearningRateWarmupCallback`` (linear-scaling warmup).
+"""
+
+import numpy as np
+
+import sparkdl_tpu.hvd as hvd
+
+
+def _keras():
+    import keras
+
+    return keras
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast rank 0's state to the gang: model variables at train
+    start (before the first update), and the lazily-built optimizer
+    variables once after the first batch."""
+
+    def __new__(cls, root_rank=0, device=""):
+        del device
+        keras = _keras()
+
+        class _Callback(keras.callbacks.Callback):
+            def __init__(self, root):
+                super().__init__()
+                self.root_rank = root
+                self._opt_done = False
+
+            def on_train_begin(self, logs=None):
+                from horovod.keras import broadcast_model_variables
+
+                broadcast_model_variables(self.model, self.root_rank)
+
+            def on_batch_end(self, batch, logs=None):
+                if self._opt_done or hvd.size() == 1:
+                    return
+                opt = getattr(self.model, "optimizer", None)
+                if opt is not None and getattr(opt, "built", False):
+                    variables = list(opt.variables)
+                    values = (
+                        [np.asarray(v) for v in variables]
+                        if hvd.rank() == self.root_rank else None
+                    )
+                    values = hvd.broadcast_object(values, self.root_rank)
+                    for v, val in zip(variables, values):
+                        v.assign(val)
+                self._opt_done = True
+
+        return _Callback(root_rank)
+
+
+class MetricAverageCallback:
+    """Average epoch-end metrics over all ranks so rank 0's history
+    describes the global job."""
+
+    def __new__(cls):
+        keras = _keras()
+
+        class _Callback(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if not logs or hvd.size() == 1:
+                    return
+                for k in list(logs.keys()):
+                    v = logs[k]
+                    if isinstance(v, (int, float, np.floating)):
+                        logs[k] = float(hvd.allreduce(
+                            np.asarray(float(v), np.float64)[None]
+                        )[0])
+
+        return _Callback()
+
+
+class LearningRateWarmupCallback:
+    """Linear LR warmup over the first ``warmup_epochs`` epochs, from
+    initial_lr to initial_lr * hvd.size() (the linear-scaling rule used
+    with Horovod data parallelism)."""
+
+    def __new__(cls, initial_lr, warmup_epochs=5, momentum_correction=True,
+                steps_per_epoch=None, verbose=0):
+        del momentum_correction, steps_per_epoch
+        keras = _keras()
+
+        class _Callback(keras.callbacks.Callback):
+            def __init__(self):
+                super().__init__()
+                self.initial_lr = initial_lr
+                self.warmup_epochs = warmup_epochs
+                self.verbose = verbose
+
+            def _set_lr(self, lr):
+                opt = self.model.optimizer
+                try:
+                    opt.learning_rate.assign(lr)
+                except AttributeError:
+                    opt.learning_rate = lr
+
+            def on_epoch_begin(self, epoch, logs=None):
+                if epoch >= self.warmup_epochs or hvd.size() == 1:
+                    return
+                progress = (epoch + 1) / self.warmup_epochs
+                lr = self.initial_lr * (1.0 + progress * (hvd.size() - 1.0))
+                self._set_lr(lr)
+                if self.verbose:
+                    print(
+                        f"LearningRateWarmupCallback: epoch {epoch} "
+                        f"lr={lr:.6g}"
+                    )
+
+        return _Callback()
+
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "LearningRateWarmupCallback",
+]

@@ -86,24 +86,68 @@ def _resolve_op(average, op):
     return SUM
 
 
+def _concrete_single_device_jax(x):
+    """True for a concrete (non-tracer) jax.Array on one device — the
+    zero-host-copy collective fast path applies."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    import jax
+
+    return (
+        isinstance(x, jax.Array)
+        and not isinstance(x, jax.core.Tracer)
+        and len(x.devices()) == 1
+    )
+
+
 def allreduce(tensor, average=None, name=None, op=None):
     """Allreduce across all ranks. Default op is Average, matching
     Horovod's gradient-averaging semantics (required for
-    DistributedOptimizer parity, BASELINE.json north star)."""
+    DistributedOptimizer parity, BASELINE.json north star).
+
+    Device-resident ``jax.Array`` inputs take a zero-host-copy path:
+    the local shard joins the gang's global array (metadata only) and
+    the reduced result stays on this process's device."""
     del name
     _state.require_initialized()
+    if _concrete_single_device_jax(tensor):
+        return engine().reduce_jax(tensor, _resolve_op(average, op))
     x = to_numpy(tensor)
-    out = engine().reduce(np.ascontiguousarray(x), _resolve_op(average, op))
+    out = engine().reduce(np.asarray(x, order="C"), _resolve_op(average, op))
     return from_numpy_like(out, tensor)
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None):
     """Fused allreduce of a tensor list: one collective per dtype
-    (Horovod tensor-fusion semantics) instead of one per tensor."""
+    (Horovod tensor-fusion semantics) instead of one per tensor.
+
+    All-jax input lists stay on device: the concat/split bookkeeping
+    runs as XLA ops and the collective takes the zero-host-copy path."""
     del name
     _state.require_initialized()
     kind = _resolve_op(average, op)
-    arrays = [np.ascontiguousarray(to_numpy(t)) for t in tensors]
+    if tensors and all(_concrete_single_device_jax(t) for t in tensors):
+        import jax.numpy as jnp
+
+        by_dtype = {}
+        for i, t in enumerate(tensors):
+            by_dtype.setdefault(jnp.dtype(t.dtype), []).append(i)
+        out = [None] * len(tensors)
+        for dtype, idxs in by_dtype.items():
+            flat = (
+                jnp.concatenate([tensors[i].ravel() for i in idxs])
+                if len(idxs) > 1 else tensors[idxs[0]].ravel()
+            )
+            red = engine().reduce_jax(flat, kind)
+            offset = 0
+            for i in idxs:
+                n = tensors[i].size
+                out[i] = red[offset:offset + n].reshape(tensors[i].shape)
+                offset += n
+        return out
+    arrays = [np.asarray(to_numpy(t), order="C") for t in tensors]
     by_dtype = {}
     for i, a in enumerate(arrays):
         by_dtype.setdefault(a.dtype, []).append(i)
@@ -128,7 +172,7 @@ def allgather(tensor, name=None):
     del name
     _state.require_initialized()
     x = to_numpy(tensor)
-    out = engine().allgather(np.ascontiguousarray(x))
+    out = engine().allgather(np.asarray(x, order="C"))
     return from_numpy_like(out, tensor)
 
 
@@ -136,7 +180,7 @@ def broadcast(tensor, root_rank, name=None):
     del name
     _state.require_initialized()
     x = to_numpy(tensor)
-    out = engine().broadcast(np.ascontiguousarray(x), root_rank)
+    out = engine().broadcast(np.asarray(x, order="C"), root_rank)
     return from_numpy_like(out, tensor)
 
 
@@ -184,7 +228,7 @@ def check_synchronized(tree, name="parameters", atol=0.0):
     _state.require_initialized()
     if size() == 1:
         return True
-    leaves = [np.ascontiguousarray(to_numpy(l)) for l in jax.tree.leaves(tree)]
+    leaves = [np.asarray(to_numpy(l), order="C") for l in jax.tree.leaves(tree)]
     hint = (
         "Did you forget broadcast_parameters/broadcast_variables, or is "
         "there non-deterministic data-dependent control flow?"
@@ -263,7 +307,7 @@ def alltoall(tensor, splits=None, name=None):
     # take different collective sequences and deadlock the gang.
     split_table = eng.allgather(np.asarray(splits, np.int64)[None, :])
     if (split_table == split_table.flat[0]).all():
-        out = eng.alltoall_equal(np.ascontiguousarray(x))
+        out = eng.alltoall_equal(np.asarray(x, order="C"))
         return from_numpy_like(out, tensor)
     # Ragged: everyone pads each destination chunk to the global max
     # split, one equal all_to_all, then trim using the exchanged table.
@@ -293,7 +337,7 @@ def reducescatter(tensor, op=None, name=None):
             f"reducescatter requires dim0 ({x.shape[0]}) divisible by size ({n})"
         )
     full = engine().reduce(
-        np.ascontiguousarray(x), _resolve_op(None, op) if op else AVERAGE
+        np.asarray(x, order="C"), _resolve_op(None, op) if op else AVERAGE
     )
     chunk = x.shape[0] // n
     return from_numpy_like(full[rank() * chunk : (rank() + 1) * chunk], tensor)

@@ -181,6 +181,48 @@ class _CollectiveEngine:
             out = out.astype(np.bool_)
         return out
 
+    def reduce_jax(self, x, op):
+        """Allreduce a DEVICE-RESIDENT ``jax.Array`` without any host
+        crossing: assembling the global array from the local shard is
+        metadata-only, the collective is the same compiled shard_map
+        psum, and the returned array stays on this process's device.
+        This is the fast path for framework grads that already live on
+        the chip (keras-3-jax custom loops, dlpack'd torch tensors)."""
+        import jax
+
+        import jax.numpy as jnp
+
+        st = _state.state()
+        if st.size == 1:
+            return x
+        self._ensure_mesh()
+        in_graph_avg = op == AVERAGE and _is_float_dtype(x.dtype)
+        if op == AVERAGE and not in_graph_avg:
+            # integer/bool average needs the host detour for horovod's
+            # truncation semantics; rare for device-resident tensors.
+            return self.reduce(np.asarray(x), op)
+        kind = "avg" if in_graph_avg else (
+            "sum" if op in (SUM, AVERAGE) else op
+        )
+        squeeze_bool = x.dtype == jnp.bool_
+        if squeeze_bool:
+            # Match the host path's bool semantics: reduce as uint8 and
+            # restore (XLA would widen a bool psum to int32 counts).
+            x = x.astype(jnp.uint8)
+        fn = self._compiled(kind, tuple(x.shape), x.dtype)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = jax.device_put(x[None], self._local_device)
+        global_arr = jax.make_array_from_single_device_arrays(
+            (st.size,) + tuple(x.shape),
+            NamedSharding(self._mesh, P("hvd")),
+            [local],
+        )
+        out = fn(global_arr).addressable_shards[0].data[0]
+        if squeeze_bool:
+            out = out.astype(jnp.bool_)
+        return out
+
     def allgather(self, x_np):
         """Horovod allgather: concatenate along axis 0; ranks may have
         different dim0 (horovod semantics). Implemented as size-exchange

@@ -25,6 +25,11 @@ def _allreduce_main(scale):
     avg = hvd.allreduce(x)
     gathered = hvd.allgather(np.array([[hvd.rank()]], np.int32))
     bcast = hvd.broadcast(np.array([hvd.rank() * 7.0], np.float32), root_rank=1)
+    # 0-d tensors must keep their shape (regression: ascontiguousarray
+    # silently promoted scalars to (1,), breaking keras Variable.assign
+    # on scalar optimizer state like SGD/iteration).
+    scalar = hvd.broadcast(np.asarray(np.int32(3 + hvd.rank())), root_rank=0)
+    scalar_sum = hvd.allreduce(np.asarray(np.float32(1.0)), op=hvd.Sum)
     from sparkdl_tpu.horovod import log_to_driver
 
     log_to_driver(f"rank {hvd.rank()} done")
@@ -35,6 +40,8 @@ def _allreduce_main(scale):
         "avg": avg.tolist(),
         "gathered": gathered.tolist(),
         "bcast": bcast.tolist(),
+        "scalar_shapes": [np.shape(scalar), np.shape(scalar_sum)],
+        "scalar_bcast": int(np.asarray(scalar)),
     }
 
 
@@ -49,6 +56,8 @@ def test_np_minus_two_gang(capfd):
     assert result["avg"] == [1.5, 1.5, 1.5]
     assert result["gathered"] == [[0], [1]]
     assert result["bcast"] == [7.0]  # root_rank=1 contributed 1*7
+    assert result["scalar_shapes"] == [(), ()]  # 0-d stays 0-d
+    assert result["scalar_bcast"] == 3  # rank 0's value
     out = capfd.readouterr().out
     assert "rank 0 done" in out  # log_to_driver surfaced on the driver
     assert "rank 1 done" in out

@@ -70,5 +70,6 @@ def test_launcher_falls_back_without_pyspark():
     from sparkdl_tpu.horovod import launcher
 
     # _resolve_num_workers works and launch path exists
-    n, mode = launcher._resolve_num_workers(-2)
+    n, mode, total = launcher._resolve_num_workers(-2)
+    assert total is None  # local mode: no slot accounting
     assert (n, mode) == (2, "local")
