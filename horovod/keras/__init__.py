@@ -179,16 +179,11 @@ def DistributedOptimizer(optimizer, name=None, compression=None,
     return optimizer
 
 
-def broadcast_model_variables(model, root_rank=0):
-    """Synchronize every model (and built optimizer) variable to
-    ``root_rank``'s values — horovod's broadcast_variables for Keras 3
-    (determinism contract, SURVEY.md §5.2). All values ship in ONE
-    fused broadcast_object (a per-variable collective would compile a
-    fresh program per shape and stall the first step on big models)."""
-    variables = list(model.variables)
-    opt = getattr(model, "optimizer", None)
-    if opt is not None and getattr(opt, "built", False):
-        variables += list(opt.variables)
+def broadcast_variables(variables, root_rank=0):
+    """Broadcast a list of (keras or backend) variables from root_rank
+    — the ``hvd.broadcast_variables`` surface existing horovod mains
+    call. All values ship in ONE fused broadcast_object."""
+    variables = list(variables)
     if hvd.size() == 1 or not variables:
         return
     values = (
@@ -198,6 +193,19 @@ def broadcast_model_variables(model, root_rank=0):
     values = hvd.broadcast_object(values, root_rank)
     for v, val in zip(variables, values):
         v.assign(val)
+
+
+def broadcast_model_variables(model, root_rank=0):
+    """Synchronize every model (and built optimizer) variable to
+    ``root_rank``'s values (determinism contract, SURVEY.md §5.2). All
+    values ship in ONE fused broadcast_object (a per-variable
+    collective would compile a fresh program per shape and stall the
+    first step on big models)."""
+    variables = list(model.variables)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "built", False):
+        variables += list(opt.variables)
+    broadcast_variables(variables, root_rank)
 
 
 class LogCallback:
@@ -275,7 +283,8 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "allreduce", "allgather", "broadcast",
     "broadcast_object", "barrier", "DistributedOptimizer",
-    "broadcast_model_variables", "BroadcastGlobalVariablesCallback",
-    "LogCallback", "init_distribution", "callbacks", "Average", "Sum",
-    "Min", "Max", "Compression",
+    "broadcast_variables", "broadcast_model_variables",
+    "BroadcastGlobalVariablesCallback", "LogCallback",
+    "init_distribution", "callbacks", "Average", "Sum", "Min", "Max",
+    "Compression",
 ]

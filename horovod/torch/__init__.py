@@ -101,6 +101,40 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
     optimizer.load_state_dict(synced)
 
 
+def _dlpack_allreduce(flat_torch, op):
+    """torch → jax → collective → torch with dlpack zero-copy at both
+    crossings. Returns None when the dlpack IMPORT fails (caller falls
+    back to the numpy bridge). The collective itself runs OUTSIDE any
+    fallback: re-running it after a post-collective failure would
+    execute the gang's compiled program twice on this rank only,
+    pairing with the peers' next-step collective and silently shifting
+    the whole gang off by one."""
+    try:
+        import jax
+
+        x = jax.dlpack.from_dlpack(flat_torch)
+    except Exception:
+        return None
+    out = engine().reduce_jax(x, op)
+    return torch.from_dlpack(out)
+
+
+def _use_dlpack(ps):
+    """dlpack beats the numpy bridge only when the grads do NOT live on
+    host CPU: for torch-cpu tensors, ``.numpy()`` is already a
+    zero-copy view and the numpy bridge measured FASTER (66 vs 147 ms
+    on a 16 MB fused buffer, 2-proc CPU gang) because the jax-array
+    path pays eager dispatch per op. Device-resident torch tensors (a
+    cuda/xla build) skip the host detour entirely via dlpack; override
+    with SPARKDL_TPU_TORCH_DLPACK=0/1."""
+    import os
+
+    flag = os.environ.get("SPARKDL_TPU_TORCH_DLPACK")
+    if flag is not None:
+        return flag == "1"
+    return any(p.grad.device.type != "cpu" for p in ps)
+
+
 def _fused_allreduce_grads(params, op, compression=None):
     """Flatten all grads per dtype into one buffer → one collective per
     dtype → scatter back (tensor-fusion analogue). With fp16
@@ -109,8 +143,31 @@ def _fused_allreduce_grads(params, op, compression=None):
     by_dtype = {}
     for p in params:
         if p.grad is not None:
-            by_dtype.setdefault(p.grad.dtype, []).append(p)
-    for dtype, ps in by_dtype.items():
+            # Key on device too: torch.cat cannot fuse across devices
+            # (e.g. embeddings pinned to host while the rest is on an
+            # accelerator).
+            by_dtype.setdefault((p.grad.dtype, p.grad.device), []).append(p)
+    for (dtype, _device), ps in by_dtype.items():
+        out_t = None
+        if compression is None and _use_dlpack(ps):
+            flat = (
+                torch.cat([p.grad.detach().reshape(-1) for p in ps])
+                if len(ps) > 1
+                else ps[0].grad.detach().reshape(-1).contiguous()
+            )
+            out_t = _dlpack_allreduce(flat, op)
+        if out_t is not None:
+            offset = 0
+            with torch.no_grad():
+                for p in ps:
+                    n = p.grad.numel()
+                    p.grad.copy_(
+                        out_t[offset:offset + n].view(p.grad.shape)
+                    )
+                    offset += n
+            continue
+        # numpy bridge: the measured-fastest path for host tensors
+        # (.numpy() is a view, not a copy), and the compression path.
         flats = [p.grad.detach().cpu().numpy().ravel() for p in ps]
         buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
         buf = np.ascontiguousarray(buf)

@@ -81,17 +81,23 @@ class _CollectiveEngine:
             return fn
         self._ensure_mesh()
         mesh = self._mesh
+        # Reduction kinds drop the stacking axis INSIDE the compiled
+        # program (block (1, *S) in → S out): callers get the final
+        # shape straight from the shard with no eager slice op
+        # (measured ~5 ms/call on 16 MB for an eager [0]).
         if kind == "sum":
-            body = lambda x: jax.lax.psum(x, "hvd")
+            body = lambda x: jax.lax.psum(x[0], "hvd")
         elif kind == "avg":
             # Average INSIDE the compiled program: host-side division
             # would allocate + traverse the full tensor again per call
             # (measured ~2x end-to-end allreduce time at 64 MB).
-            body = lambda x: jax.lax.psum(x, "hvd") / jax.lax.axis_size("hvd")
+            body = lambda x: (
+                jax.lax.psum(x[0], "hvd") / jax.lax.axis_size("hvd")
+            )
         elif kind == "min":
-            body = lambda x: jax.lax.pmin(x, "hvd")
+            body = lambda x: jax.lax.pmin(x[0], "hvd")
         elif kind == "max":
-            body = lambda x: jax.lax.pmax(x, "hvd")
+            body = lambda x: jax.lax.pmax(x[0], "hvd")
         elif kind == "gather":
             # tiled all_gather along leading axis
             body = lambda x: jax.lax.all_gather(x, "hvd", axis=0, tiled=True)
@@ -166,7 +172,7 @@ class _CollectiveEngine:
         if squeeze_bool:
             x_np = x_np.astype(np.uint8)
         fn = self._compiled(kind, x_np.shape, x_np.dtype)
-        out = self._local_out(fn(self._to_global(x_np)))[0]
+        out = self._local_out(fn(self._to_global(x_np)))
         if op == AVERAGE and not in_graph_avg:
             if np.issubdtype(out.dtype, np.integer):
                 out = out.astype(np.float64)
@@ -200,7 +206,11 @@ class _CollectiveEngine:
         if op == AVERAGE and not in_graph_avg:
             # integer/bool average needs the host detour for horovod's
             # truncation semantics; rare for device-resident tensors.
-            return self.reduce(np.asarray(x), op)
+            # Re-wrap as a jax.Array: reduce_jax's contract is
+            # jax.Array in, jax.Array out.
+            return jax.device_put(
+                self.reduce(np.asarray(x), op), self._local_device
+            )
         kind = "avg" if in_graph_avg else (
             "sum" if op in (SUM, AVERAGE) else op
         )
@@ -218,7 +228,7 @@ class _CollectiveEngine:
             NamedSharding(self._mesh, P("hvd")),
             [local],
         )
-        out = fn(global_arr).addressable_shards[0].data[0]
+        out = fn(global_arr).addressable_shards[0].data
         if squeeze_bool:
             out = out.astype(jnp.bool_)
         return out
