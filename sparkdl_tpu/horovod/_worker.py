@@ -13,10 +13,25 @@ which merges all ranks into the job log (reference ``runner_base.py:
 62-72``).
 """
 
+import contextlib
 import io
 import os
 import sys
 import traceback
+
+
+class _NullFile:
+    """Stand-in local log for environments without a job dir (Spark
+    barrier tasks tee straight to the control plane)."""
+
+    def write(self, s):
+        return len(s)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
 
 
 class _TeeStream(io.TextIOBase):
@@ -69,17 +84,17 @@ def _set_parent_death_signal():
         pass
 
 
-def main():
-    from sparkdl_tpu.hvd import _state
+@contextlib.contextmanager
+def worker_io(rank, local_log_path=None):
+    """The worker observability bootstrap, shared by the local gang
+    worker and Spark barrier tasks: control-plane client + driver
+    watchdog, parent-death signal, stdout/stderr tee to the driver (so
+    ``driver_log_verbosity`` works in EVERY backend, reference
+    ``runner_base.py:62-72``), EXC frames on failure, BYE on exit.
 
-    rank = int(os.environ["SPARKDL_TPU_RANK"])
-    job_dir = os.environ["SPARKDL_TPU_JOB_DIR"]
-    payload_path = os.environ["SPARKDL_TPU_PAYLOAD"]
-
-    # 1. Platform selection must happen before any JAX backend init.
-    _state.ensure_jax_platform()
-
-    # 2. Control plane + log tee (before anything can print).
+    Yields the control-plane client (None outside a job). Exceptions
+    propagate to the caller after their traceback has been teed and
+    shipped as an EXC frame."""
     from sparkdl_tpu.horovod.control_plane import get_worker_client
 
     client = get_worker_client()
@@ -89,45 +104,26 @@ def main():
         # (even via SIGKILL) so orphans never pin chips or leases.
         client.start_driver_watchdog()
     _set_parent_death_signal()
-    local_log = open(os.path.join(job_dir, f"rank-{rank}.log"), "a", buffering=1)
+    local_log = (
+        open(local_log_path, "a", buffering=1) if local_log_path
+        else _NullFile()
+    )
     orig_stdout, orig_stderr = sys.stdout, sys.stderr
     sys.stdout = _TeeStream("stdout", local_log, client)
     sys.stderr = _TeeStream("stderr", local_log, client)
-
     exit_code = 0
     try:
-        # 3. Gang rendezvous: jax.distributed.initialize against the
-        # launcher's coordinator (replaces MPI rendezvous, BASELINE.json).
-        import sparkdl_tpu.hvd as hvd
-
-        hvd.init()
-
-        # 4. Tell the driver this worker is up (gang barrier on the
-        # driver side — fail-fast if any worker never arrives, reference
-        # runner_base.py:54-58).
-        if client is not None:
-            client.send_ready()
-
-        # 5. Deserialize and run the user main (under a per-rank
-        # profiler trace when SPARKDL_TPU_PROFILE is set).
-        import cloudpickle
-
-        from sparkdl_tpu.utils.profiler import maybe_trace_worker
-
-        with open(payload_path, "rb") as f:
-            user_main, kwargs = cloudpickle.load(f)
-        with maybe_trace_worker(rank):
-            result = user_main(**kwargs)
-
-        # 6. Rank 0's return value goes back to the driver.
-        if hvd.rank() == 0 and client is not None:
-            client.send_result(cloudpickle.dumps(result))
-    except BaseException:
+        yield client
+    except BaseException as e:
         exit_code = 1
         tb = traceback.format_exc()
         sys.stderr.write(tb + "\n")
         if client is not None:
             client.send_exception(tb)
+        # Mark as already-recorded so outer handlers don't duplicate
+        # the traceback into the same log.
+        e._sparkdl_recorded = True
+        raise
     finally:
         sys.stdout.flush()
         sys.stderr.flush()
@@ -138,6 +134,59 @@ def main():
             client.send_bye(exit_code)
             client.close()
         local_log.close()
+
+
+def main():
+    from sparkdl_tpu.hvd import _state
+
+    rank = int(os.environ["SPARKDL_TPU_RANK"])
+    job_dir = os.environ["SPARKDL_TPU_JOB_DIR"]
+    payload_path = os.environ["SPARKDL_TPU_PAYLOAD"]
+
+    # 1. Platform selection must happen before any JAX backend init.
+    _state.ensure_jax_platform()
+
+    exit_code = 0
+    try:
+        # 2. Control plane + log tee (before anything can print).
+        with worker_io(
+            rank, os.path.join(job_dir, f"rank-{rank}.log")
+        ) as client:
+            # 3. Gang rendezvous: jax.distributed.initialize against
+            # the launcher's coordinator (replaces MPI rendezvous,
+            # BASELINE.json).
+            import sparkdl_tpu.hvd as hvd
+
+            hvd.init()
+
+            # 4. Tell the driver this worker is up (gang barrier on the
+            # driver side — fail-fast if any worker never arrives,
+            # reference runner_base.py:54-58).
+            if client is not None:
+                client.send_ready()
+
+            # 5. Deserialize and run the user main (under a per-rank
+            # profiler trace when SPARKDL_TPU_PROFILE is set).
+            import cloudpickle
+
+            from sparkdl_tpu.utils.profiler import maybe_trace_worker
+
+            with open(payload_path, "rb") as f:
+                user_main, kwargs = cloudpickle.load(f)
+            with maybe_trace_worker(rank):
+                result = user_main(**kwargs)
+
+            # 6. Rank 0's return value goes back to the driver.
+            if hvd.rank() == 0 and client is not None:
+                client.send_result(cloudpickle.dumps(result))
+    except BaseException as e:
+        exit_code = 1
+        if not getattr(e, "_sparkdl_recorded", False):
+            # Bootstrap failure BEFORE the tee existed (control plane
+            # unreachable, unwritable job dir): stderr is still the
+            # launcher's O_APPEND boot log — print there or the
+            # launcher reports an opaque 'exited 1' with an empty log.
+            traceback.print_exc()
     sys.exit(exit_code)
 
 

@@ -257,13 +257,14 @@ def _resolve_num_workers(np_arg):
 
 
 def _worker_env(base_env, *, rank, size, coordinator, control_addr,
-                control_secret, payload_path, job_dir, platform):
+                control_secret, payload_path, job_dir, platform,
+                placement=None):
+    from sparkdl_tpu.horovod.topology import Placement
+
     env = dict(base_env)
     env.update({
         "SPARKDL_TPU_RANK": str(rank),
         "SPARKDL_TPU_SIZE": str(size),
-        "SPARKDL_TPU_LOCAL_RANK": str(rank),   # single-host gang
-        "SPARKDL_TPU_LOCAL_SIZE": str(size),
         "SPARKDL_TPU_COORDINATOR": coordinator,
         "SPARKDL_TPU_CONTROL_ADDR": control_addr,
         # Per-job credential for the control plane: the driver
@@ -273,6 +274,22 @@ def _worker_env(base_env, *, rank, size, coordinator, control_addr,
         "SPARKDL_TPU_PAYLOAD": payload_path,
         "SPARKDL_TPU_JOB_DIR": job_dir,
     })
+    # Topology: SPARKDL_TPU_HOSTS defines a hosts x slots grid
+    # (reference runner_base.py:44-45, :54-55 — slots live on task
+    # NODES); default is the single-host gang. The hosts-spec path also
+    # computes the TPU pod-slice env so externally-placed workers (one
+    # per chip across a slice) come up on the ICI mesh.
+    if placement is None:
+        placement = Placement.from_env(base_env)
+    if placement is None:
+        placement = Placement.single_host(size)
+    for k, v in placement.env_for_rank(rank, tpu=platform == "tpu").items():
+        if (k in ("TPU_PROCESS_BOUNDS", "TPU_CHIPS_PER_PROCESS_BOUNDS")
+                and base_env.get(k)):
+            # An operator-exported slice layout (e.g. a 2D "2,2,1"
+            # grid) overrides the linear default.
+            continue
+        env[k] = v
     if platform:
         env["SPARKDL_TPU_FORCE_PLATFORM"] = platform
     # The driver's XLA_FLAGS (e.g. a forced 8-device host platform in
@@ -285,13 +302,6 @@ def _worker_env(base_env, *, rank, size, coordinator, control_addr,
             if not f.startswith("--xla_force_host_platform_device_count")
         ]
         env["XLA_FLAGS"] = " ".join(kept)
-    if platform == "tpu" and size > 1:
-        # One task <-> one chip (reference runner_base.py:44-45, GPU →
-        # TPU): restrict each worker to its own chip so gangs on a
-        # multi-chip host don't fight over the device.
-        env["TPU_VISIBLE_DEVICES"] = str(rank)
-        env.setdefault("TPU_PROCESS_BOUNDS", "1,1,1")
-        env.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")
     return env
 
 
@@ -428,6 +438,12 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         )
         coordinator = f"127.0.0.1:{_free_port()}"
         platform = os.environ.get(WORKER_PLATFORM_ENV)
+        from sparkdl_tpu.horovod.topology import Placement
+
+        gang_placement = (
+            Placement.from_env(os.environ)
+            or Placement.single_host(num_workers)
+        )
 
         logger.info(
             "Launching HorovodRunner gang: %d worker(s), mode=%s, job_dir=%s",
@@ -439,7 +455,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 coordinator=coordinator, control_addr=server.address,
                 control_secret=server.secret,
                 payload_path=payload_paths[r], job_dir=job_dir,
-                platform=platform,
+                platform=platform, placement=gang_placement,
             )
             # Boot-phase output (before the worker installs its log tee
             # — e.g. import errors) lands in the same per-rank log file

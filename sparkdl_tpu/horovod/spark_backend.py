@@ -16,6 +16,8 @@ TPU VM and in CI — pyspark is an optional dependency, matching the
 reference's zero-install_requires packaging, reference ``setup.py:41``).
 """
 
+import os
+
 from pyspark.sql import SparkSession
 from pyspark import BarrierTaskContext
 
@@ -25,7 +27,8 @@ class SparkGangResult:
         self.value = value
 
 
-def _barrier_main(payload_bytes, verbosity, control_addr, control_secret):
+def _barrier_main(payload_bytes, verbosity, control_addr, control_secret,
+                  worker_platform=None):
     """Runs inside each barrier task (executor-side)."""
 
     def run_partition(_):
@@ -58,16 +61,47 @@ def _barrier_main(payload_bytes, verbosity, control_addr, control_secret):
         if control_addr:
             os.environ["SPARKDL_TPU_CONTROL_ADDR"] = control_addr
             os.environ["SPARKDL_TPU_CONTROL_SECRET"] = control_secret
+
+        # Multi-host topology from the barrier task infos: local rank =
+        # position among this host's tasks (reference runner_base.py:
+        # 44-45 — slots live on task NODES), plus TPU pod-slice env
+        # when the executors hold chips.
+        from sparkdl_tpu.horovod.topology import placement_from_task_hosts
+
+        hosts = [i.address.rsplit(":", 1)[0] for i in infos]
+        placement = placement_from_task_hosts(hosts)
+        # The DRIVER decides the platform (its env ships through this
+        # closure) — the executor's own env says nothing, and assuming
+        # TPU on a CPU cluster would inject pod env (and reject
+        # non-uniform task layouts) where none applies.
+        on_tpu = worker_platform == "tpu"
+        # Force-assign: pyspark reuses python workers across jobs
+        # (spark.python.worker.reuse), so a setdefault would keep the
+        # PREVIOUS job's rank-specific TPU identity.
+        os.environ.update(placement.env_for_rank(rank, tpu=on_tpu))
+        if worker_platform:
+            os.environ["SPARKDL_TPU_FORCE_PLATFORM"] = worker_platform
+
         ctx.barrier()  # gang start: all together (runner_base.py:54-55)
 
-        import sparkdl_tpu.hvd as hvd
+        # Same observability bootstrap as the local worker: stdout/
+        # stderr tee'd to the driver per driver_log_verbosity, EXC
+        # frames, driver watchdog (reference runner_base.py:62-72 — a
+        # barrier worker's failure must surface as a rank-tagged
+        # traceback on the driver, not an opaque Spark task error).
+        from sparkdl_tpu.horovod._worker import worker_io
 
-        hvd.init()
-        user_main, kwargs = cloudpickle.loads(payload_bytes)
-        result = user_main(**kwargs)
         out = []
-        if hvd.rank() == 0:
-            out.append(cloudpickle.dumps(result))
+        with worker_io(rank) as client:
+            import sparkdl_tpu.hvd as hvd
+
+            hvd.init()
+            if client is not None:
+                client.send_ready()
+            user_main, kwargs = cloudpickle.loads(payload_bytes)
+            result = user_main(**kwargs)
+            if hvd.rank() == 0:
+                out.append(cloudpickle.dumps(result))
         return out
 
     return run_partition
@@ -85,25 +119,50 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
 
     sc = spark.sparkContext
     # Fail fast if the cluster cannot host the gang (runner_base.py:56-58).
+    # (Busy-slot WAITING is Spark's own scheduler behavior: a barrier
+    # job with free total capacity queues until slots drain.)
     total_slots = int(sc.defaultParallelism)
     if num_workers > total_slots:
-        raise RuntimeError(
+        from sparkdl_tpu.horovod.launcher import SlotExhaustionError
+
+        raise SlotExhaustionError(
             f"HorovodRunner requested np={num_workers} but the cluster has "
             f"only {total_slots} task slots; failing fast."
         )
+    import tempfile
+
+    job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-spark-job-")
     # Bind on all interfaces and advertise a routable driver address —
     # executors on other hosts must be able to reach log_to_driver's
     # channel (reference sparkdl/horovod/__init__.py:20-25).
     server = ControlPlaneServer(
-        num_workers, verbosity=driver_log_verbosity, bind_host="0.0.0.0"
+        num_workers, verbosity=driver_log_verbosity, bind_host="0.0.0.0",
+        log_path=os.path.join(job_dir, "job.log"),
     )
     try:
         payload = cloudpickle.dumps((main, kwargs))
         rdd = sc.parallelize(range(num_workers), num_workers).barrier()
-        pickled = rdd.mapPartitions(
-            _barrier_main(payload, driver_log_verbosity, server.address,
-                          server.secret)
-        ).collect()
+        try:
+            pickled = rdd.mapPartitions(
+                _barrier_main(payload, driver_log_verbosity, server.address,
+                              server.secret,
+                              os.environ.get("SPARKDL_TPU_WORKER_PLATFORM"))
+            ).collect()
+        except Exception as e:
+            # Surface the rank-tagged tracebacks the workers shipped
+            # over the control plane instead of Spark's opaque task
+            # failure (reference runner_base.py:62-72).
+            server.wait_drained(5.0)
+            excs = server.exceptions
+            detail = "\n".join(
+                f"--- rank {r} ---\n{tb}" for r, tb in sorted(excs.items())
+            )
+            if detail:
+                raise RuntimeError(
+                    f"HorovodRunner Spark job failed:\n{detail}\n"
+                    f"Merged job log: {job_dir}/job.log"
+                ) from e
+            raise
         if not pickled:
             raise RuntimeError("Spark barrier job returned no rank-0 result")
         return SparkGangResult(cloudpickle.loads(pickled[0]))

@@ -1,0 +1,156 @@
+"""Gang topology: rank placement across hosts and TPU pod-slice env.
+
+The reference's slot model spans the cluster — "each process will take
+an available task slot ... on the task nodes" (reference
+``runner_base.py:44-45``, ``:54-55``) — so a gang is a HOSTS x SLOTS
+grid, not a flat local list. This module owns that mapping:
+
+- :func:`parse_hosts` reads an mpirun-style host spec
+  (``"host1:4,host2:4"``, the launcher's ``SPARKDL_TPU_HOSTS`` env).
+- :class:`Placement` maps global rank -> (host index, local_rank,
+  local_size) with hosts filled in order, and derives the per-process
+  env a worker needs: the horovod-side LOCAL_* values plus the TPU
+  runtime's pod-slice variables (``TPU_PROCESS_BOUNDS``,
+  ``TPU_CHIPS_PER_PROCESS_BOUNDS``, ``CLOUD_TPU_TASK_ID``,
+  ``TPU_PROCESS_ADDRESSES``) so ``jax.distributed.initialize`` on a
+  real v4/v5 pod slice sees one process per chip laid out on the ICI
+  mesh.
+
+Single-host gangs (the launcher's default) are the 1-host special case;
+the Spark barrier backend derives its Placement from the barrier task
+infos instead of an env spec (executors already know their hosts).
+"""
+
+import os
+
+HOSTS_ENV = "SPARKDL_TPU_HOSTS"
+TPU_PORT_BASE = 8476  # libtpu's default inter-process port
+
+
+def parse_hosts(spec):
+    """``"h1:4,h2:4"`` -> ``[("h1", 4), ("h2", 4)]``; a bare host means
+    one slot. Raises ValueError on malformed entries."""
+    hosts = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        host, sep, slots = entry.partition(":")
+        if not host:
+            raise ValueError(f"empty host in host spec {spec!r}")
+        try:
+            n = int(slots) if sep else 1
+        except ValueError:
+            raise ValueError(
+                f"bad slot count {slots!r} for host {host!r} in {spec!r}"
+            )
+        if n < 1:
+            raise ValueError(f"host {host!r} has {n} slots in {spec!r}")
+        hosts.append((host, n))
+    if not hosts:
+        raise ValueError(f"no hosts in host spec {spec!r}")
+    return hosts
+
+
+class Placement:
+    """Rank layout over ``[(host, slots), ...]``, hosts filled in
+    order: rank 0..s0-1 on host 0, the next s1 on host 1, ..."""
+
+    def __init__(self, hosts):
+        self.hosts = list(hosts)
+        self.total_slots = sum(n for _, n in self.hosts)
+        self._host_of = []
+        self._local_of = []
+        for hi, (_, n) in enumerate(self.hosts):
+            for li in range(n):
+                self._host_of.append(hi)
+                self._local_of.append(li)
+
+    @classmethod
+    def from_env(cls, environ=os.environ):
+        """Placement from SPARKDL_TPU_HOSTS, or None when unset (the
+        single-host default)."""
+        spec = environ.get(HOSTS_ENV)
+        return cls(parse_hosts(spec)) if spec else None
+
+    @classmethod
+    def single_host(cls, slots, host="localhost"):
+        return cls([(host, slots)])
+
+    def host_index(self, rank):
+        return self._host_of[rank]
+
+    def host(self, rank):
+        return self.hosts[self._host_of[rank]][0]
+
+    def local_rank(self, rank):
+        return self._local_of[rank]
+
+    def local_size(self, rank):
+        return self.hosts[self._host_of[rank]][1]
+
+    def env_for_rank(self, rank, *, tpu=False):
+        """The per-process env for ``rank``: horovod LOCAL_* values,
+        plus TPU pod-slice layout when ``tpu`` (one process per chip;
+        process grid = hosts x slots-per-host on the ICI mesh)."""
+        if not 0 <= rank < self.total_slots:
+            raise ValueError(
+                f"rank {rank} outside gang of {self.total_slots}"
+            )
+        env = {
+            "SPARKDL_TPU_LOCAL_RANK": str(self.local_rank(rank)),
+            "SPARKDL_TPU_LOCAL_SIZE": str(self.local_size(rank)),
+        }
+        if tpu and self.total_slots > 1:
+            # One task <-> one chip (reference runner_base.py:44-45,
+            # GPU -> TPU): restrict each worker to its own chip.
+            env["TPU_VISIBLE_DEVICES"] = str(self.local_rank(rank))
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+            if len(self.hosts) == 1:
+                # Single host: isolated single-chip runtimes; the gang
+                # coordinates via jax.distributed only (matches the
+                # launcher's long-standing behavior on multi-chip VMs).
+                env.setdefault("TPU_PROCESS_BOUNDS", "1,1,1")
+                return env
+            slots = self.hosts[0][1]
+            if any(n != slots for _, n in self.hosts):
+                raise ValueError(
+                    "TPU pod slices need a uniform chips-per-host "
+                    f"layout; got {self.hosts}"
+                )
+            # Pod slice: one process per chip, process grid tiled
+            # linearly (hosts-major). Same-host processes get distinct
+            # ports (base + local_rank). Larger 2D/3D slice shapes
+            # should export TPU_PROCESS_BOUNDS themselves; this linear
+            # spec covers the common N-host x M-chip rows.
+            n_hosts = len(self.hosts)
+            env.update({
+                "TPU_PROCESS_BOUNDS": f"{n_hosts * slots},1,1",
+                "CLOUD_TPU_TASK_ID": str(rank),
+                "TPU_PROCESS_PORT": str(
+                    TPU_PORT_BASE + self.local_rank(rank)
+                ),
+                "TPU_PROCESS_ADDRESSES": ",".join(
+                    f"{self.host(r)}:{TPU_PORT_BASE + self.local_rank(r)}"
+                    for r in range(self.total_slots)
+                ),
+            })
+        return env
+
+
+def placement_from_task_hosts(host_of_rank):
+    """Placement for an ALREADY-SCHEDULED gang (Spark barrier mode):
+    ``host_of_rank[r]`` is the host executing rank r. Local ranks are
+    assigned by order of appearance within each host, so they are
+    stable across the gang regardless of scheduling interleave."""
+    seen = {}
+    locals_ = []
+    for h in host_of_rank:
+        locals_.append(seen.get(h, 0))
+        seen[h] = locals_[-1] + 1
+    p = Placement([(h, n) for h, n in seen.items()])
+    # Override the order-derived tables: scheduled gangs may interleave
+    # hosts, e.g. ranks [h0, h1, h0, h1].
+    p._host_of = [list(seen).index(h) for h in host_of_rank]
+    p._local_of = locals_
+    return p
