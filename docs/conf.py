@@ -35,8 +35,11 @@ master_doc = "index"
 exclude_patterns = ["_build"]
 html_theme = "classic"
 html_static_path = ["static"]
+templates_path = ["_templates"]  # theme hook (reference layout.html)
 html_css_files = ["sparkdl_tpu.css"]  # the docs skin (reference ships
 # a classic-theme skin the same way, docs/static/pysparkdl.css)
+html_js_files = ["sparkdl_tpu.js"]  # badge/anchor behavior (the
+# reference attaches pysparkdl.js the same way via its layout.html)
 
 # Unlike the reference, whose docstrings are epytext and need the
 # docs/epytext.py autodoc rewrite hook, every docstring here is native

@@ -1,0 +1,62 @@
+/* sparkdl-tpu API docs behavior — the functional counterpart of the
+   reference's docs/static/pysparkdl.js (jQuery), rebuilt dependency-
+   free: lift "Experimental"/"Deprecated" admonition notes into inline
+   badges next to the API object they annotate, and give autodoc
+   definition terms hover permalinks. */
+
+(function () {
+  "use strict";
+
+  function makeBadge(text, cls) {
+    var span = document.createElement("span");
+    span.className = "sparkdl-badge " + cls;
+    span.textContent = text;
+    return span;
+  }
+
+  function liftBadges() {
+    document.querySelectorAll("dl dd > div.admonition.note").forEach(
+      function (note) {
+        var p = note.querySelector("p:last-child");
+        if (!p) return;
+        var text = p.textContent.trim();
+        var badge = null;
+        if (text.indexOf("Experimental") === 0) {
+          badge = makeBadge("Experimental", "sparkdl-badge-experimental");
+        } else if (text.indexOf("Deprecated") === 0) {
+          badge = makeBadge("Deprecated", "sparkdl-badge-deprecated");
+        }
+        if (!badge) return;
+        var dd = note.parentElement;
+        var dt = dd.previousElementSibling;
+        if (dt && dt.tagName === "DT") {
+          var anchor = dt.querySelector("a.headerlink");
+          dt.insertBefore(badge, anchor);
+        }
+      }
+    );
+  }
+
+  function markSidebarModules() {
+    // Give sidebar module links a stable class so the skin can style
+    // the API nav like the reference's module map.
+    document
+      .querySelectorAll("div.sphinxsidebar a.reference.internal")
+      .forEach(function (a) {
+        var href = a.getAttribute("href") || "";
+        if (href.indexOf("#module-") === 0) {
+          a.classList.add("sparkdl-module-link");
+        }
+      });
+  }
+
+  if (document.readyState === "loading") {
+    document.addEventListener("DOMContentLoaded", function () {
+      liftBadges();
+      markSidebarModules();
+    });
+  } else {
+    liftBadges();
+    markSidebarModules();
+  }
+})();
