@@ -3,8 +3,10 @@
 bench.py workload under candidate configs so the best one can be
 promoted into bench.py. Prints one JSON line per variant.
 
-Variants: attention policy (XLA reference vs pallas flash with the
-fused backward), batch size, remat.
+Default variants: loss path (materialized logits vs fused chunked
+cross-entropy at several chunk sizes, incl. a bf16-matmul unembed) and
+batch size. Set SPARKDL_TPU_VARIANTS_FULL=1 to also sweep the attention
+policy (XLA reference vs pallas flash) and long-sequence remat configs.
 """
 
 import json
@@ -19,7 +21,8 @@ import functools
 import numpy as np
 
 
-def measure(attention, batch, seq, remat=False, n_steps=20):
+def measure(attention, batch, seq, remat=False, n_steps=20,
+            loss="logits", chunk=512, ce_bf16=False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -27,6 +30,7 @@ def measure(attention, batch, seq, remat=False, n_steps=20):
     from sparkdl_tpu.models import Llama, LlamaConfig, lora_mask
     from sparkdl_tpu.parallel.train import (
         cross_entropy_loss,
+        fused_cross_entropy,
         make_train_step,
     )
 
@@ -42,9 +46,19 @@ def measure(attention, batch, seq, remat=False, n_steps=20):
     opt = optax.masked(optax.adamw(1e-4), mask)
     opt_state = opt.init(params)
 
-    def loss_fn(p, b):
-        logits = model.apply({"params": p}, b["inputs"])
-        return cross_entropy_loss(logits, b["targets"])
+    if loss == "fused":
+        def loss_fn(p, b):
+            hidden = model.apply({"params": p}, b["inputs"],
+                                 return_hidden=True)
+            return fused_cross_entropy(
+                hidden, p["lm_head"]["kernel"], b["targets"],
+                chunk_size=chunk, freeze_head=True,
+                matmul_dtype=jnp.bfloat16 if ce_bf16 else None,
+            )
+    else:
+        def loss_fn(p, b):
+            logits = model.apply({"params": p}, b["inputs"])
+            return cross_entropy_loss(logits, b["targets"])
 
     step = make_train_step(loss_fn, opt, param_mask=mask, remat=remat)
     rng = np.random.default_rng(0)
@@ -78,12 +92,26 @@ def measure(attention, batch, seq, remat=False, n_steps=20):
 def main():
     variants = [
         {"attention": "reference", "batch": 8, "seq": 1024},
-        {"attention": "flash", "batch": 8, "seq": 1024},
-        {"attention": "reference", "batch": 16, "seq": 1024},
-        {"attention": "flash", "batch": 16, "seq": 1024},
-        {"attention": "flash", "batch": 4, "seq": 4096, "remat": True},
-        {"attention": "reference", "batch": 4, "seq": 4096, "remat": True},
+        {"attention": "reference", "batch": 8, "seq": 1024,
+         "loss": "fused", "chunk": 256},
+        {"attention": "reference", "batch": 8, "seq": 1024,
+         "loss": "fused", "chunk": 512},
+        {"attention": "reference", "batch": 8, "seq": 1024,
+         "loss": "fused", "chunk": 1024},
+        {"attention": "reference", "batch": 8, "seq": 1024,
+         "loss": "fused", "chunk": 512, "ce_bf16": True},
+        {"attention": "reference", "batch": 16, "seq": 1024,
+         "loss": "fused", "chunk": 512},
     ]
+    if os.environ.get("SPARKDL_TPU_VARIANTS_FULL"):
+        variants += [
+            {"attention": "flash", "batch": 8, "seq": 1024},
+            {"attention": "flash", "batch": 16, "seq": 1024},
+            {"attention": "flash", "batch": 4, "seq": 4096,
+             "remat": True},
+            {"attention": "reference", "batch": 4, "seq": 4096,
+             "remat": True},
+        ]
     for v in variants:
         try:
             tps = measure(**v)

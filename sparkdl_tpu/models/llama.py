@@ -235,7 +235,12 @@ class Llama(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden=False):
+        """``return_hidden=True`` skips the lm_head matmul and returns
+        the final-norm hidden states — the input contract of
+        :func:`sparkdl_tpu.parallel.train.fused_cross_entropy`, which
+        fuses unembed+softmax-CE in sequence chunks. Init traces with
+        the default so the param tree always contains ``lm_head``."""
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
@@ -256,6 +261,8 @@ class Llama(nn.Module):
                 x, cos, sin, positions
             )
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        if return_hidden:
+            return x
         # fp32 head: stability for the softmax/sampling path. (A bf16
         # head was measured on v5e and did NOT beat this — XLA already
         # runs the fp32 matmul as bf16x3 passes and the extra output
