@@ -1,9 +1,13 @@
 #!/usr/bin/env python
 """KV-cache decode throughput (tokens/sec) for the serving path: one
-prefill + one scanned decode program (models/generate.py). Prints one
-JSON line. Run on a TPU host; SPARKDL_TPU_BENCH_TINY=1 for a CPU smoke.
+prefill + one scanned decode program (models/generate.py), for the
+dense bf16 model AND the int8 weight-only variant (models/quant.py —
+decode is HBM-bound, int8 halves the weight read). Prints one JSON
+line per variant. Run on a TPU host; SPARKDL_TPU_BENCH_TINY=1 for a
+CPU smoke.
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -12,13 +16,34 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def measure(model, params, prompt, new, batch):
+    import numpy as np
+
+    from sparkdl_tpu.models.generate import generate
+
+    # Warm (compiles prefill + decode_loop once).
+    out = generate(model, params, prompt, max_new_tokens=new)
+    np.asarray(out)
+
+    t0 = time.perf_counter()
+    out = generate(model, params, prompt, max_new_tokens=new)
+    np.asarray(out)  # host readback = true sync
+    dt = time.perf_counter() - t0
+    return batch * new / dt
+
+
 def main():
+    plat = os.environ.get("SPARKDL_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from sparkdl_tpu.models import Llama, LlamaConfig
-    from sparkdl_tpu.models.generate import generate
+    from sparkdl_tpu.models.quant import quantize_llama_params
 
     if os.environ.get("SPARKDL_TPU_BENCH_TINY"):
         cfg = LlamaConfig.tiny(max_cache_len=128)
@@ -37,22 +62,28 @@ def main():
     )
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
 
-    # Warm (compiles prefill + decode_loop once).
-    out = generate(model, params, prompt, max_new_tokens=new)
-    np.asarray(out)
-
-    t0 = time.perf_counter()
-    out = generate(model, params, prompt, max_new_tokens=new)
-    np.asarray(out)  # host readback = true sync
-    dt = time.perf_counter() - t0
-    tps = batch * new / dt
+    tps = measure(model, params, prompt, new, batch)
     print(json.dumps({
         "metric": "llama_decode_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "batch": batch, "prompt_len": p_len, "new_tokens": new,
         "platform": jax.devices()[0].platform,
-    }))
+    }), flush=True)
+
+    q_tree = quantize_llama_params(jax.tree.map(np.asarray, params))
+    q_tree = jax.device_put(q_tree)  # keep the H2D upload out of the
+    # timed run (the bf16 tree is already device-resident)
+    cfg_q = dataclasses.replace(cfg, quant="int8")
+    tps_q = measure(Llama(cfg_q), q_tree, prompt, new, batch)
+    print(json.dumps({
+        "metric": "llama_decode_int8_tokens_per_sec",
+        "value": round(tps_q, 1),
+        "unit": "tokens/sec",
+        "batch": batch, "prompt_len": p_len, "new_tokens": new,
+        "vs_bf16": round(tps_q / tps, 3),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,8 @@ class LlamaConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
+    quant: str = ""               # "" (dense) | "int8" weight-only serving
+                                  # (params from models.quant.quantize_llama_params)
 
     @classmethod
     def llama3_8b(cls, **kw):
@@ -55,6 +57,21 @@ class LlamaConfig:
 
 
 def _dense(cfg, features, name):
+    if cfg.quant not in ("", "int8"):
+        raise ValueError(
+            f"unknown quant mode {cfg.quant!r}; expected '' or 'int8'"
+        )
+    if cfg.quant == "int8":
+        # Serving mode: LoRA must be merged first (merge_lora_with) —
+        # a bf16 adapter over an int8 base is not supported.
+        if cfg.lora_rank:
+            raise ValueError(
+                "quant='int8' requires lora_rank=0 (merge adapters "
+                "with merge_lora_with, then quantize)"
+            )
+        from sparkdl_tpu.models.quant import QuantDense
+
+        return QuantDense(features=features, dtype=cfg.dtype, name=name)
     if cfg.lora_rank and name in cfg.lora_targets:
         return LoRADense(features=features, rank=cfg.lora_rank,
                          alpha=cfg.lora_alpha, dtype=cfg.dtype, name=name)
@@ -263,6 +280,11 @@ class Llama(nn.Module):
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         if return_hidden:
             return x
+        if cfg.quant == "int8":
+            from sparkdl_tpu.models.quant import QuantDense
+
+            return QuantDense(cfg.vocab_size, dtype=jnp.float32,
+                              name="lm_head")(x.astype(jnp.float32))
         # fp32 head: stability for the softmax/sampling path. (A bf16
         # head was measured on v5e and did NOT beat this — XLA already
         # runs the fp32 matmul as bf16x3 passes and the extra output

@@ -1,0 +1,67 @@
+"""Weight-only int8 serving mode for the decoder models.
+
+Decode-time matmuls are HBM-bandwidth bound (the batch dimension is
+tiny, so every step re-reads the full weight matrix); storing weights
+as int8 with per-output-channel scales halves the bytes vs bf16 and
+the MXU still accumulates in fp32 via
+:func:`sparkdl_tpu.ops.pallas.quantized_matmul.quantized_matmul`.
+
+Usage (serving):
+
+    cfg_q  = dataclasses.replace(cfg, quant="int8", lora_rank=0)
+    q_tree = quantize_llama_params(params)       # after merge_lora_with
+    out    = Llama(cfg_q).apply({"params": q_tree}, tokens)
+
+The reference has no quantized path at all (its serving story is the
+plain estimator ``transform``); this is TPU-first beyond-parity work on
+the serving side.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.ops.pallas.quantized_matmul import (
+    DEFAULT_QUANT_TARGETS,
+    quantize_params,
+    quantized_matmul,
+)
+
+# Single source of truth for which Llama layers go int8 (the kernel
+# module owns the default; embeddings stay dense — a lookup reads one
+# row, quantization saves nothing there).
+LLAMA_QUANT_TARGETS = DEFAULT_QUANT_TARGETS
+
+
+class QuantDense(nn.Module):
+    """Drop-in Dense over int8 weights + fp32 per-column scales.
+
+    Param names match :func:`quantize_params` output (``kernel_q``,
+    ``kernel_scale``) so a quantized checkpoint applies directly.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        w_q = self.param(
+            "kernel_q",
+            lambda key, shape: jnp.zeros(shape, jnp.int8),
+            (d_in, self.features),
+        )
+        scale = self.param(
+            "kernel_scale", nn.initializers.ones, (self.features,)
+        )
+        lead = x.shape[:-1]
+        flat = x.reshape((-1, d_in)).astype(self.dtype)
+        out = quantized_matmul(flat, w_q, scale)
+        return out.reshape(lead + (self.features,)).astype(self.dtype)
+
+
+def quantize_llama_params(params, targets=LLAMA_QUANT_TARGETS):
+    """Convert a trained (or LoRA-merged) Llama param tree to the int8
+    layout ``Llama(cfg with quant="int8")`` expects. Returns the new
+    tree (bytes-saved bookkeeping is in :func:`quantize_params`)."""
+    q_tree, _ = quantize_params(params, targets=targets)
+    return q_tree

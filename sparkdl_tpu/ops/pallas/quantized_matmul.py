@@ -87,9 +87,14 @@ def quantized_matmul(x, w_q, scales, *, interpret=None):
     return out[:m, :n] if (pad_m or pad_n) else out
 
 
-def quantize_params(params, targets=("gate_proj", "up_proj", "down_proj",
-                                     "q_proj", "k_proj", "v_proj",
-                                     "o_proj", "lm_head")):
+# Dense layers quantized by default: every 2-D projection of the
+# decoder family; embeddings stay dense (a lookup reads one row).
+DEFAULT_QUANT_TARGETS = ("gate_proj", "up_proj", "down_proj",
+                         "q_proj", "k_proj", "v_proj",
+                         "o_proj", "lm_head")
+
+
+def quantize_params(params, targets=DEFAULT_QUANT_TARGETS):
     """Quantize matching kernel leaves of a flax param tree →
     (new_params with int8 'kernel_q' + 'kernel_scale', bytes saved)."""
 

@@ -1,0 +1,84 @@
+"""int8 weight-only serving mode: Llama(quant="int8") over a converted
+param tree must match the dense model evaluated on the dequantized
+weights (the conversion is the only approximation), and the cached
+decode path must generate identical greedy tokens.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models import Llama, LlamaConfig
+from sparkdl_tpu.models.generate import generate
+from sparkdl_tpu.models.quant import quantize_llama_params
+from sparkdl_tpu.ops.pallas.quantized_matmul import dequantize_params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    # Give weights some spread so quantization is non-trivial.
+    params = jax.tree.map(
+        lambda p: p * 1.7 if p.ndim == 2 else p, params
+    )
+    return cfg, model, tokens, params
+
+
+def test_int8_apply_matches_dequantized_dense(setup):
+    cfg, model, tokens, params = setup
+    q_tree = quantize_llama_params(params)
+    cfg_q = dataclasses.replace(cfg, quant="int8")
+    out_q = Llama(cfg_q).apply({"params": q_tree}, tokens)
+
+    deq = dequantize_params(q_tree, dtype=jnp.float32)
+    out_d = model.apply({"params": deq}, tokens)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_d),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_int8_output_close_to_unquantized(setup):
+    cfg, model, tokens, params = setup
+    q_tree = quantize_llama_params(params)
+    cfg_q = dataclasses.replace(cfg, quant="int8")
+    out_q = Llama(cfg_q).apply({"params": q_tree}, tokens)
+    out_f = model.apply({"params": params}, tokens)
+    # int8 is lossy; logits stay within quantization noise
+    err = np.abs(np.asarray(out_q) - np.asarray(out_f)).mean()
+    scale = np.abs(np.asarray(out_f)).mean()
+    assert err < 0.1 * scale, (err, scale)
+
+
+def test_int8_greedy_decode_matches_dequantized(setup):
+    cfg, model, tokens, params = setup
+    q_tree = quantize_llama_params(params)
+    cfg_q = dataclasses.replace(cfg, quant="int8", max_cache_len=32)
+    toks_q = generate(Llama(cfg_q), q_tree, tokens[:, :6],
+                      max_new_tokens=8, temperature=0.0)
+
+    deq = dequantize_params(q_tree, dtype=jnp.float32)
+    cfg_d = dataclasses.replace(cfg, max_cache_len=32)
+    toks_d = generate(Llama(cfg_d), deq, tokens[:, :6],
+                      max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(toks_q), np.asarray(toks_d))
+
+
+def test_unknown_quant_mode_rejected():
+    cfg = LlamaConfig.tiny(quant="int4")
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        Llama(cfg).init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+
+
+def test_quant_with_lora_rejected():
+    cfg = LlamaConfig.tiny(quant="int8", lora_rank=4)
+    with pytest.raises(ValueError, match="merge"):
+        Llama(cfg).init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
