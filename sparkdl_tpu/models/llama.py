@@ -41,6 +41,25 @@ class LlamaConfig:
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
     quant: str = ""               # "" (dense) | "int8" weight-only serving
                                   # (params from models.quant.quantize_llama_params)
+    # Sparse-FFN (Mixtral-style) decoder: n_experts > 0 replaces the
+    # dense MLP with a top-k routed expert MLP on every moe_every-th
+    # layer (1 = all layers). Router-balance aux loss: apply with
+    # mutable=["intermediates"] + models.moe.moe_aux_loss.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 1
+
+    def __post_init__(self):
+        if self.n_experts > 0:
+            if not 0 < self.moe_top_k <= self.n_experts:
+                raise ValueError(
+                    f"moe_top_k={self.moe_top_k} must be in "
+                    f"[1, n_experts={self.n_experts}]"
+                )
+            if self.moe_every < 1:
+                raise ValueError(
+                    f"moe_every={self.moe_every} must be >= 1"
+                )
 
     @classmethod
     def llama3_8b(cls, **kw):
@@ -235,6 +254,7 @@ class MLP(nn.Module):
 class Block(nn.Module):
     cfg: LlamaConfig
     attention_fn: Optional[Callable] = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin, positions):
@@ -242,9 +262,18 @@ class Block(nn.Module):
         h = x + Attention(cfg, self.attention_fn, name="attn")(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), cos, sin, positions
         )
-        return h + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_eps, name="mlp_norm")(h)
-        )
+        if self.use_moe:
+            from sparkdl_tpu.models.moe import MoEConfig, MoEMLP
+
+            mlp = MoEMLP(
+                MoEConfig(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                          n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+                          dtype=cfg.dtype),
+                name="moe_mlp",
+            )
+        else:
+            mlp = MLP(cfg, name="mlp")
+        return h + mlp(RMSNorm(cfg.rms_eps, name="mlp_norm")(h))
 
 
 class Llama(nn.Module):
@@ -274,9 +303,10 @@ class Llama(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
         for i in range(cfg.n_layers):
-            x = block(cfg, self.attention_fn, name=f"layer_{i}")(
-                x, cos, sin, positions
-            )
+            use_moe = (cfg.n_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, self.attention_fn, use_moe,
+                      name=f"layer_{i}")(x, cos, sin, positions)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         if return_hidden:
             return x

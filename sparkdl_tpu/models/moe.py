@@ -52,19 +52,65 @@ class MoEMLP(nn.Module):
             (cfg.n_experts, cfg.d_ff, cfg.d_model),
         ).astype(cfg.dtype)
 
-        gates = moe_gates(
-            router(x.astype(jnp.float32)), cfg.top_k
-        ).astype(cfg.dtype)                       # (..., E)
+        probs = jax.nn.softmax(
+            router(x.astype(jnp.float32)), axis=-1
+        )                                          # (..., E)
+        # Sown for the router-balance auxiliary loss: training reads it
+        # via apply(..., mutable=["intermediates"]) + moe_aux_loss.
+        self.sow("intermediates", "router_probs", probs)
+        gates = gates_from_probs(probs, cfg.top_k).astype(cfg.dtype)
         return moe_apply(x, gates, w_gate, w_up, w_down)
 
 
-def moe_gates(logits, top_k):
-    """Top-k softmax gates, renormalized over the selected experts."""
-    probs = jax.nn.softmax(logits, axis=-1)
+def gates_from_probs(probs, top_k):
+    """Top-k gates from router probabilities, renormalized over the
+    selected experts."""
     top_vals, _ = jax.lax.top_k(probs, top_k)
     thresh = top_vals[..., -1:]
     gated = jnp.where(probs >= thresh, probs, 0.0)
     return gated / jnp.maximum(gated.sum(axis=-1, keepdims=True), 1e-9)
+
+
+def moe_gates(logits, top_k):
+    """Top-k softmax gates, renormalized over the selected experts."""
+    return gates_from_probs(jax.nn.softmax(logits, axis=-1), top_k)
+
+
+def load_balance_loss(probs, top_k):
+    """Router load-balance auxiliary (switch-transformer form,
+    generalized to top-k): ``E * sum_e f_e * P_e`` where ``f_e`` is the
+    fraction of tokens routing to expert e (top-k membership) and
+    ``P_e`` the mean router probability. Perfectly balanced routing
+    gives ``top_k``; imbalance grows it toward ``E * top_k``."""
+    n_experts = probs.shape[-1]
+    flat = probs.reshape(-1, n_experts)
+    top_vals, _ = jax.lax.top_k(flat, top_k)
+    chosen = (flat >= top_vals[..., -1:]).astype(jnp.float32)
+    f = chosen.mean(axis=0)
+    p = flat.mean(axis=0)
+    return n_experts * jnp.sum(f * p)
+
+
+def moe_aux_loss(intermediates, top_k):
+    """Sum :func:`load_balance_loss` over every sown ``router_probs``
+    in an ``intermediates`` collection (one per MoE layer). Raises if
+    none are present — a silent 0.0 would let the router train without
+    balancing (the usual cause: forgetting
+    ``mutable=["intermediates"]`` on apply)."""
+    losses = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            intermediates)[0]:
+        # sow stores a tuple per call; each element is one probs array
+        if any(str(getattr(p, "key", "")) == "router_probs"
+               for p in path):
+            losses.append(load_balance_loss(leaf, top_k))
+    if not losses:
+        raise ValueError(
+            "no router_probs found in intermediates — pass the "
+            "'intermediates' collection from apply(..., "
+            "mutable=['intermediates']) on an MoE model"
+        )
+    return jnp.stack(losses).sum()
 
 
 def moe_apply(x, gates, w_gate, w_up, w_down, axis_name=None):

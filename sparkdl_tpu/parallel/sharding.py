@@ -75,5 +75,10 @@ TRANSFORMER_RULES = [
     (r"lm_head.*kernel", (("fsdp",), "model")),
     (r"lora_a$", (None, None)),
     (r"lora_b$", (None, "model")),
+    # Stacked MoE expert weights (E, d, f): experts over 'model' (the
+    # expert-parallel axis of the GSPMD path; router stays replicated)
+    # and the per-expert matrix over 'fsdp' like every dense kernel —
+    # expert weights are the dominant memory, they must not lose ZeRO-3.
+    (r"w_(gate|up|down)$", ("model", ("fsdp",))),
     (r"(norm|ln|layernorm).*", ()),
 ]
