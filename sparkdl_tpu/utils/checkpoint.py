@@ -13,6 +13,16 @@ should checkpoint from rank 0 — see :func:`should_save`).
 import os
 
 
+def _process_index():
+    """This process's index in the jax world (0 when not distributed)."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
 def should_save():
     """In a gang, only rank 0 persists (workers hold replicated state)."""
     from sparkdl_tpu.hvd import _state
@@ -28,20 +38,49 @@ class TrainCheckpointer:
     keep-last-N retention and atomic writes.
     """
 
-    def __init__(self, directory, max_to_keep=3):
+    def __init__(self, directory, max_to_keep=3, async_save=False):
+        """``async_save=True`` returns from :meth:`save` as soon as the
+        state is snapshotted to host memory; the disk write proceeds in
+        the background (orbax AsyncCheckpointer) so the train loop's
+        next step overlaps the IO instead of stalling on it. Restores,
+        a following save, and :meth:`close` all join the pending write
+        first.
+
+        Gang semantics: HorovodRunner gangs are N independent
+        single-controller jax worlds (state replicated per rank), NOT
+        one multihost GSPMD world — so each rank's manager is pinned
+        process-local (orbax's cross-process barriers would otherwise
+        deadlock: the non-primary rank skips the write without entering
+        the barrier the primary waits in). Rank 0 persists
+        (:func:`should_save` gates :meth:`save`); any rank may
+        :meth:`restore`, ordered by the caller (``hvd.barrier()``
+        between a save and a dependent restore)."""
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(directory)
+        self._async = bool(async_save)
         os.makedirs(self._dir, exist_ok=True)
+        pidx = _process_index()
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                # the root dir is created above (orbax's create=True is
+                # unsupported with active_processes pinned)
+                max_to_keep=max_to_keep, create=False,
+                enable_async_checkpointing=self._async,
+                multiprocessing_options=(
+                    ocp.options.MultiprocessingOptions(
+                        primary_host=pidx,
+                        active_processes={pidx},
+                        barrier_sync_key_prefix=f"rank{pidx}",
+                    )
+                ),
             ),
         )
 
     def save(self, step, state, force=False):
-        """state: any pytree (e.g. {'params': ..., 'opt_state': ...})."""
+        """state: any pytree (e.g. {'params': ..., 'opt_state': ...}).
+        Blocks until durable unless ``async_save`` was set."""
         import orbax.checkpoint as ocp
 
         if not should_save():
@@ -49,11 +88,27 @@ class TrainCheckpointer:
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
-        self._mgr.wait_until_finished()
+        if not self._async:
+            self._mgr.wait_until_finished()
         return saved
 
+    def wait_until_finished(self):
+        """Join any in-flight async write (no-op when idle)."""
+        self._mgr.wait_until_finished()
+
     def latest_step(self):
+        if self._async:
+            self._mgr.wait_until_finished()
+        self._refresh_if_reader()
         return self._mgr.latest_step()
+
+    def _refresh_if_reader(self):
+        """Gang non-writers: this manager's step bookkeeping was
+        scanned at construction; rescan so steps rank 0 wrote since
+        (or retention deleted since) are visible. Ordering between a
+        write and a dependent read is the caller's barrier."""
+        if _process_index() != 0:
+            self._mgr.reload()
 
     def restore(self, step=None, target=None):
         """Restore a step (default latest). Pass ``target`` (a pytree of
@@ -61,6 +116,12 @@ class TrainCheckpointer:
         control placement of the restored arrays."""
         import orbax.checkpoint as ocp
 
+        if self._async:
+            # join any in-flight write: orbax registers the step in its
+            # bookkeeping synchronously, so without this a restore
+            # could target a step still being committed
+            self._mgr.wait_until_finished()
+        self._refresh_if_reader()
         if step is None:
             step = self._mgr.latest_step()
         if step is None:

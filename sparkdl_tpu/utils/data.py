@@ -39,6 +39,37 @@ def prefetch_to_device(iterator, size=2, sharding=None):
         yield out
 
 
+def shard_for_rank(arrays, rank=None, size=None, *, drop_last=True):
+    """Slice each leaf's leading axis to this gang member's contiguous
+    shard (the data-parallel input split: each HorovodRunner worker
+    reads only its 1/size of the epoch).
+
+    rank/size default to the initialized gang
+    (:mod:`sparkdl_tpu.hvd`); pass them explicitly outside a gang.
+    """
+    import jax
+
+    if rank is None:
+        from sparkdl_tpu import hvd
+
+        rank = hvd.rank()
+    if size is None:
+        from sparkdl_tpu import hvd
+
+        size = hvd.size()
+    if not 0 <= rank < size:
+        raise ValueError(f"rank {rank} outside [0, {size})")
+
+    leaves = jax.tree.leaves(arrays)
+    n = leaves[0].shape[0]
+    if drop_last:
+        per = n // size
+        lo, hi = rank * per, (rank + 1) * per
+    else:
+        lo, hi = rank * n // size, (rank + 1) * n // size
+    return jax.tree.map(lambda x: x[lo:hi], arrays)
+
+
 def batched(arrays, batch_size, *, shuffle=False, seed=0, drop_last=True):
     """Minimal epoch iterator over a pytree of equally-long arrays."""
     import numpy as np

@@ -362,3 +362,45 @@ def test_local_mode_streams_worker_stdout(capfd):
     out = capfd.readouterr().out
     assert "stdout from rank 0" in out
     assert "stdout from rank 1" in out
+
+
+@pytest.mark.gang
+def test_gang_checkpoint_rank0_saves(tmp_path):
+    """TrainCheckpointer inside a gang: each rank's orbax manager is
+    process-local (regression: the default cross-process coordination
+    deadlocked — the primary rank waited in a barrier the non-primary
+    skipped), rank 0 persists, and restore sees the saved state."""
+
+    def main(ckpt_dir):
+        import numpy as np
+
+        import sparkdl_tpu.hvd as hvd
+        from sparkdl_tpu.utils.checkpoint import (
+            TrainCheckpointer,
+            should_save,
+        )
+
+        hvd.init()
+        total = hvd.allreduce(
+            np.float32(hvd.rank() + 1.0), op=hvd.Sum
+        )
+        ckpt = TrainCheckpointer(ckpt_dir, async_save=True)
+        try:
+            saved = ckpt.save(1, {"total": np.asarray(total)})
+            ckpt.wait_until_finished()  # async write -> durable
+            hvd.barrier()               # writers before readers
+            restored = ckpt.restore(
+                target={"total": np.zeros((), np.float32)}
+            )
+        finally:
+            ckpt.close()
+        return {
+            "rank": hvd.rank(),
+            "saved": bool(saved),
+            "should": should_save(),
+            "restored": float(restored["total"]),
+        }
+
+    result = HorovodRunner(np=-2).run(main, ckpt_dir=str(tmp_path / "ck"))
+    assert result["rank"] == 0 and result["saved"] and result["should"]
+    assert result["restored"] == 3.0  # 1 + 2

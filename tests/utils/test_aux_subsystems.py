@@ -38,6 +38,31 @@ def test_checkpoint_save_restore_roundtrip(tmp_path):
         ckpt.close()
 
 
+def test_async_checkpoint_roundtrip(tmp_path):
+    """async_save returns before the write is durable; the snapshot is
+    taken at save() time, so mutating host state afterwards must not
+    corrupt the checkpoint."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    w = np.arange(6.0).reshape(2, 3)
+    state = {"params": {"w": w.copy()}, "step": jnp.asarray(1)}
+    ckpt = TrainCheckpointer(str(tmp_path / "async"), async_save=True)
+    try:
+        assert ckpt.save(1, state)
+        # train loop moves on immediately; mutate the SAVED buffer in
+        # place — the write must have snapshotted, not kept a live ref
+        state["params"]["w"] *= 100.0
+        ckpt.wait_until_finished()
+        restored = ckpt.restore()
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), w
+        )
+    finally:
+        ckpt.close()
+
+
 def test_checkpoint_restore_empty_raises(tmp_path):
     from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
 

@@ -26,6 +26,26 @@ def test_batched_and_prefetch_roundtrip():
     assert sorted(all_y.tolist()) == list(range(10))
 
 
+def test_shard_for_rank_partitions_epoch():
+    import pytest
+
+    from sparkdl_tpu.utils.data import shard_for_rank
+
+    data = {"x": np.arange(10, dtype=np.int32)}
+    shards = [shard_for_rank(data, r, 3)["x"] for r in range(3)]
+    # drop_last: equal 1/size shards, disjoint and in order
+    assert [s.tolist() for s in shards] == [[0, 1, 2], [3, 4, 5],
+                                            [6, 7, 8]]
+    # keep remainder: every element appears exactly once
+    full = np.concatenate([
+        shard_for_rank(data, r, 3, drop_last=False)["x"]
+        for r in range(3)
+    ])
+    np.testing.assert_array_equal(full, data["x"])
+    with pytest.raises(ValueError, match="outside"):
+        shard_for_rank(data, 3, 3)
+
+
 def test_prefetch_with_sharding():
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
