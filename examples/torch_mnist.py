@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""MNIST CNN in PyTorch under HorovodRunner — the unmodified
+horovod.torch recipe (init, scale LR by size, DistributedOptimizer,
+broadcast parameters/optimizer state from rank 0), with the collectives
+riding this framework's XLA backend instead of MPI/NCCL
+(reference runner_base.py:44-45: one task slot = one accelerator).
+
+Run locally:          python examples/torch_mnist.py
+Local 4-process gang: python examples/torch_mnist.py -4
+Cluster gang:         python examples/torch_mnist.py 8
+"""
+
+import sys
+
+from sparkdl import HorovodRunner
+
+
+def train_hvd(learning_rate=0.05, epochs=2):
+    import numpy as np
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    import horovod.torch as hvd
+    from sparkdl.horovod import log_to_driver
+
+    hvd.init()
+    torch.manual_seed(0)
+
+    # synthetic MNIST-shaped data so the example runs offline; swap in
+    # torchvision.datasets.MNIST when you have the real thing. Each
+    # rank reads a disjoint shard (the data-parallel contract).
+    rng = np.random.RandomState(hvd.rank())
+    x = torch.tensor(rng.rand(1024, 1, 28, 28), dtype=torch.float32)
+    y = torch.tensor(rng.randint(0, 10, 1024))
+
+    model = nn.Sequential(
+        nn.Conv2d(1, 32, 3), nn.ReLU(),
+        nn.Conv2d(32, 64, 3), nn.ReLU(),
+        nn.MaxPool2d(2), nn.Flatten(),
+        nn.Linear(64 * 12 * 12, 128), nn.ReLU(),
+        nn.Linear(128, 10),
+    )
+    opt = torch.optim.SGD(model.parameters(),
+                          lr=learning_rate * hvd.size())
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters()
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    model.train()
+    for epoch in range(epochs):
+        perm = torch.randperm(x.shape[0])
+        losses = []
+        for i in range(0, x.shape[0], 64):
+            idx = perm[i:i + 64]
+            opt.zero_grad()
+            loss = F.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        if hvd.rank() == 0:
+            log_to_driver(
+                f"epoch {epoch}: loss {sum(losses) / len(losses):.4f}"
+            )
+
+    model.eval()
+    with torch.no_grad():
+        acc = (model(x).argmax(1) == y).float().mean()
+    return float(acc)
+
+
+if __name__ == "__main__":
+    np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -1
+    acc = HorovodRunner(np=np_arg).run(train_hvd)
+    print(f"final accuracy (rank 0): {acc:.3f}")

@@ -9,9 +9,11 @@ import numpy as np
 import pytest
 
 from sparkdl_tpu.models import Llama, LlamaConfig
+from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
 from sparkdl_tpu.parallel.train import (
     cross_entropy_loss,
     fused_cross_entropy,
+    shard_batch,
 )
 
 B, S, D, V = 2, 12, 16, 37  # S deliberately not divisible by chunk
@@ -89,6 +91,41 @@ def test_freeze_head_zeroes_w_grad(data):
     )(hidden, w)
     assert np.any(np.asarray(gh))        # activations still flow
     assert not np.any(np.asarray(gw))    # head frozen
+
+
+def test_fused_ce_under_pjit_mesh(data):
+    """The bench/flagship path: fused CE inside a jitted step over a
+    ('data','model') mesh, batch sharded on data AND the unembed head
+    sharded over model (Megatron vocab split, the lm_head rule in
+    TRANSFORMER_RULES) — GSPMD must partition the chunk scan without
+    changing values or gradients."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    hidden, w, labels = data
+    # batch of 2 -> 4 rows so data=4 divides it; vocab 37 -> 38 (one
+    # large-negative pad column, used by BOTH paths) so model=2
+    # divides the vocab axis
+    hidden4 = jnp.concatenate([hidden, hidden], axis=0)
+    labels4 = jnp.concatenate([labels, labels], axis=0)
+    w38 = jnp.concatenate([w, jnp.full((w.shape[0], 1), -30.0)], axis=1)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    ref = float(_reference(hidden4, w38, labels4))
+
+    def loss(h, w_, l):
+        return fused_cross_entropy(h, w_, l, chunk_size=5)
+
+    with mesh:
+        sharded = shard_batch({"h": hidden4, "l": labels4}, mesh)
+        w_tp = jax.device_put(
+            w38, NamedSharding(mesh, P(None, "model"))
+        )
+        got, grads = jax.jit(jax.value_and_grad(loss, argnums=1))(
+            sharded["h"], w_tp, sharded["l"]
+        )
+    np.testing.assert_allclose(float(got), ref, rtol=1e-6)
+    g_ref = jax.grad(_reference, argnums=1)(hidden4, w38, labels4)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(g_ref),
+                               atol=1e-6)
 
 
 def test_llama_return_hidden_path_matches_logits_path(data):
