@@ -113,11 +113,26 @@ def _hist_stage(binned, g, h, pos, level_start, *, nodes_d, n_bins_tot):
     return hg, hh
 
 
-def _split_stage(hist_g, hist_h, feature_mask, *, reg_lambda, reg_alpha,
-                 gamma, min_child_weight, learning_rate):
+def _split_stage(hist_g, hist_h, feature_mask, lower=None, upper=None,
+                 *, reg_lambda, reg_alpha, gamma, min_child_weight,
+                 learning_rate, monotone=None):
     """Best (feature, threshold, missing-direction) per node, plus the
     node's would-be leaf weight. All candidates evaluated in parallel on
-    the vector unit; no data-dependent control flow."""
+    the vector unit; no data-dependent control flow.
+
+    Monotone constraints (xgboost's ``monotone_constraints``):
+    ``monotone`` is a per-feature vector in {-1, 0, +1}; ``lower``/
+    ``upper`` are the node's inherited weight bounds in RAW weight
+    space (no learning-rate factor — lr > 0 preserves order, and raw
+    bounds keep the math lr-free). Candidate child weights are clamped
+    to the bounds, their gains recomputed FROM the clamped weights
+    (xgboost's CalcGainGivenWeight — an unclamped gain would overstate
+    splits whose optimum lies outside the bounds), and splits whose
+    clamped child weights violate the feature's direction are
+    rejected. The caller propagates mid bounds to the children from
+    the returned per-node child weights; together with leaf clamping
+    this makes the final forest monotone in the constrained
+    features."""
     import jax.numpy as jnp
 
     nodes_d, f, n_bins_tot = hist_g.shape
@@ -142,18 +157,52 @@ def _split_stage(hist_g, hist_h, feature_mask, *, reg_lambda, reg_alpha,
     hl = ch[..., :-1]
     parent = score(g_tot[..., :1, None], h_tot[..., :1, None])
 
+    def raw_weight(gs, hs):
+        # optimal leaf value in RAW space (no lr; lr scales at the end)
+        return -soft(gs) / (hs + reg_lambda)
+
+    def clamp(ws):
+        if lower is None:
+            return ws
+        nd = ws.ndim - 1
+        return jnp.clip(ws, lower[(...,) + (None,) * nd],
+                        upper[(...,) + (None,) * nd])
+
+    def score_given_weight(gs, hs, ws):
+        # objective reduction achieved by leaf value ws (equals
+        # score() at the unclamped optimum; smaller when bounds bite)
+        return -(2.0 * gs * ws + (hs + reg_lambda) * ws * ws
+                 + 2.0 * reg_alpha * jnp.abs(ws))
+
     def split_gain(gl_, hl_):
         # RAW loss improvement (xgboost's loss_chg); gamma is applied
         # only as the split-acceptance threshold below, so reported
         # gains match xgboost's importances under nonzero gamma.
         gr_ = g_tot[..., None] - gl_
         hr_ = h_tot[..., None] - hl_
-        gain = 0.5 * (score(gl_, hl_) + score(gr_, hr_) - parent)
+        if monotone is None:
+            gain = 0.5 * (score(gl_, hl_) + score(gr_, hr_) - parent)
+        else:
+            wl_ = clamp(raw_weight(gl_, hl_))
+            wr_ = clamp(raw_weight(gr_, hr_))
+            parent_w = clamp(raw_weight(g_tot[..., :1, None],
+                                        h_tot[..., :1, None]))
+            gain = 0.5 * (
+                score_given_weight(gl_, hl_, wl_)
+                + score_given_weight(gr_, hr_, wr_)
+                - score_given_weight(g_tot[..., :1, None],
+                                     h_tot[..., :1, None], parent_w)
+            )
         ok = (hl_ >= min_child_weight) & (hr_ >= min_child_weight)
+        if monotone is not None:
+            c = jnp.asarray(monotone, jnp.int32)[None, :, None]
+            ok = ok & ~((c > 0) & (wl_ > wr_)) & ~((c < 0) & (wl_ < wr_))
         return jnp.where(ok, gain, -jnp.inf)
 
     gain_mr = split_gain(gl, hl)                              # missing→right
-    gain_ml = split_gain(gl + miss_g[..., None], hl + miss_h[..., None])
+    gl_ml = gl + miss_g[..., None]
+    hl_ml = hl + miss_h[..., None]
+    gain_ml = split_gain(gl_ml, hl_ml)
     gain = jnp.maximum(gain_mr, gain_ml)                      # (nodes,F,B-1)
     missing_left = gain_ml >= gain_mr
     gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
@@ -167,11 +216,35 @@ def _split_stage(hist_g, hist_h, feature_mask, *, reg_lambda, reg_alpha,
         missing_left.reshape(nodes_d, -1), best[:, None], axis=1
     )[:, 0]
     # Node's leaf weight if it does NOT split (also used at final level).
-    leaf_w = -learning_rate * soft(g_tot[:, 0]) / (h_tot[:, 0] + reg_lambda)
+    raw_leaf = raw_weight(g_tot[:, 0], h_tot[:, 0])
+    if lower is not None:
+        raw_leaf = jnp.clip(raw_leaf, lower, upper)
+    leaf_w = learning_rate * raw_leaf
     empty = h_tot[:, 0] <= 0.0
     leaf_w = jnp.where(empty, 0.0, leaf_w)
     do_split = best_gain > gamma
-    return do_split, best_feat, best_thr, best_ml, leaf_w, best_gain
+
+    # Chosen split's clamped RAW child weights, for the caller's child
+    # bound propagation. Zeros when constraints are off.
+    if monotone is not None:
+        def pick(arr3):
+            return jnp.take_along_axis(
+                arr3.reshape(nodes_d, -1), best[:, None], axis=1
+            )[:, 0]
+
+        gl_best = jnp.where(best_ml, pick(gl_ml), pick(gl))
+        hl_best = jnp.where(best_ml, pick(hl_ml), pick(hl))
+        wl_best = raw_weight(gl_best, hl_best)
+        wr_best = raw_weight(g_tot[:, 0] - gl_best,
+                             h_tot[:, 0] - hl_best)
+        if lower is not None:
+            wl_best = jnp.clip(wl_best, lower, upper)
+            wr_best = jnp.clip(wr_best, lower, upper)
+    else:
+        wl_best = jnp.zeros_like(leaf_w)
+        wr_best = jnp.zeros_like(leaf_w)
+    return (do_split, best_feat, best_thr, best_ml, leaf_w, best_gain,
+            wl_best, wr_best)
 
 
 def _route_stage(binned, pos, level_start, do_split, feat, thr,
@@ -209,9 +282,27 @@ def _predict_stage(binned, feat, thr, missing_left, is_split, leaf_w,
     return leaf_w[pos]
 
 
+def _monotone_child_bounds(lower, upper, wl, wr, constraint, do_split):
+    """Child [lower, upper] RAW-weight bounds for the next level,
+    given each node's chosen split (xgboost's bound propagation: a +1
+    split caps the left subtree at mid and floors the right, mirrored
+    for -1; unconstrained features pass bounds through)."""
+    import jax.numpy as jnp
+
+    mid = 0.5 * (wl + wr)
+    pos = do_split & (constraint > 0)
+    neg = do_split & (constraint < 0)
+    l_lo = jnp.where(neg, jnp.maximum(lower, mid), lower)
+    l_hi = jnp.where(pos, jnp.minimum(upper, mid), upper)
+    r_lo = jnp.where(pos, jnp.maximum(lower, mid), lower)
+    r_hi = jnp.where(neg, jnp.minimum(upper, mid), upper)
+    interleave = lambda a, b: jnp.stack([a, b], axis=1).reshape(-1)
+    return interleave(l_lo, r_lo), interleave(l_hi, r_hi)
+
+
 def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
                       n_bins_tot, reg_lambda, reg_alpha, gamma,
-                      min_child_weight, learning_rate):
+                      min_child_weight, learning_rate, monotone=None):
     """Single-program tree builder: all levels (histogram → split →
     route) unrolled inside ONE trace, plus the tree's margin deltas.
 
@@ -235,6 +326,8 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
     leaf_arr = jnp.zeros((n_nodes,), jnp.float32)
     pos = jnp.zeros((n,), jnp.int32)
 
+    lower = jnp.full((1,), -jnp.inf, jnp.float32)
+    upper = jnp.full((1,), jnp.inf, jnp.float32)
     for d in range(max_depth + 1):
         nodes_d = 2 ** d
         level_start = nodes_d - 1
@@ -242,11 +335,14 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
             binned, g, h, pos, level_start,
             nodes_d=nodes_d, n_bins_tot=n_bins_tot,
         )
-        do_split, bf, bt, bml, leaf_w, gains = _split_stage(
-            hg, hh, feature_mask, reg_lambda=reg_lambda,
+        do_split, bf, bt, bml, leaf_w, gains, wl, wr = _split_stage(
+            hg, hh, feature_mask,
+            lower if monotone is not None else None,
+            upper if monotone is not None else None,
+            reg_lambda=reg_lambda,
             reg_alpha=reg_alpha, gamma=gamma,
             min_child_weight=min_child_weight,
-            learning_rate=learning_rate,
+            learning_rate=learning_rate, monotone=monotone,
         )
         if d == max_depth:
             do_split = jnp.zeros_like(do_split)
@@ -264,6 +360,11 @@ def _build_tree_fused(binned, g, h, feature_mask, *, max_depth,
                 binned, pos, level_start, do_split, bf, bt, bml,
                 nodes_d=nodes_d, n_bins=n_bins,
             )
+            if monotone is not None:
+                c = jnp.asarray(monotone, jnp.int32)[bf]
+                lower, upper = _monotone_child_bounds(
+                    lower, upper, wl, wr, c, do_split
+                )
 
     delta = _predict_stage(
         binned, feat_arr, thr_arr, ml_arr, split_arr, leaf_arr,
@@ -496,6 +597,52 @@ class Booster:
         return e / e.sum(axis=1, keepdims=True)
 
 
+def _parse_monotone(spec, n_features):
+    """xgboost's monotone_constraints formats → int32 (n_features,)
+    vector or None: "(1,-1,0)" string or list/tuple (length must equal
+    n_features, as in xgboost), or a partial {feature_index: c} dict
+    (unlisted features unconstrained; name-keyed dicts need a column
+    order we don't have)."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        body = spec.strip().strip("()")
+        spec = [int(s) for s in body.split(",") if s.strip()] if body \
+            else []
+    if isinstance(spec, dict):
+        if not spec:
+            return None
+        if not all(isinstance(k, (int, np.integer)) for k in spec):
+            raise ValueError(
+                "monotone_constraints dicts must be keyed by feature "
+                "index (column names are not tracked here)"
+            )
+        if not all(0 <= int(k) < n_features for k in spec):
+            raise ValueError(
+                f"monotone_constraints feature indices must be in "
+                f"[0, {n_features}); got {sorted(spec)}"
+            )
+        out = np.zeros(n_features, np.int32)
+        for idx, c in spec.items():
+            out[int(idx)] = int(c)
+        arr = out
+    else:
+        arr = np.asarray(spec, np.int32).reshape(-1)
+        if arr.size == 0:
+            return None
+        if arr.size != n_features:
+            raise ValueError(
+                f"monotone_constraints has {arr.size} entries for "
+                f"{n_features} features"
+            )
+    if not np.isin(arr, (-1, 0, 1)).all():
+        raise ValueError(
+            f"monotone_constraints values must be -1, 0, or 1; got "
+            f"{sorted(set(arr.tolist()))}"
+        )
+    return arr if np.any(arr) else None
+
+
 def train(params, X, y, *, sample_weight=None, base_margin=None,
           eval_set=None, early_stopping_rounds=None, hist_reduce=None,
           callbacks=None, verbose_eval=False, xgb_model=None):
@@ -525,6 +672,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     scale_pos_weight = float(p.pop("scale_pos_weight", 1.0))
     user_base_score = p.pop("base_score", None)
     seed = int(p.pop("random_state", p.pop("seed", 0)))
+    monotone = _parse_monotone(p.pop("monotone_constraints", None))
     n_classes = int(p.pop("num_class", 0))
     eval_metric = p.pop("eval_metric", None) or _DEFAULT_METRIC[objective]
     p["max_depth"] = max_depth
@@ -532,6 +680,16 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
     n, f = X.shape
+    if monotone is not None:
+        if monotone.shape[0] > f:
+            raise ValueError(
+                f"monotone_constraints has {monotone.shape[0]} entries "
+                f"for {f} features"
+            )
+        if monotone.shape[0] < f:  # partial dict spec: rest unconstrained
+            monotone = np.pad(monotone, (0, f - monotone.shape[0]))
+        if not np.any(monotone):
+            monotone = None  # all-zero: unconstrained
     w = (np.ones(n, np.float32) if sample_weight is None
          else np.asarray(sample_weight, np.float32))
     if scale_pos_weight != 1.0:
@@ -627,12 +785,13 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     split_fn = jax.jit(partial(
         _split_stage, reg_lambda=reg_lambda, reg_alpha=reg_alpha,
         gamma=gamma, min_child_weight=min_child_weight,
-        learning_rate=learning_rate,
+        learning_rate=learning_rate, monotone=monotone,
     ))
     fused_fn = jax.jit(partial(
         _build_tree_fused, max_depth=max_depth, n_bins_tot=n_bins_tot,
         reg_lambda=reg_lambda, reg_alpha=reg_alpha, gamma=gamma,
         min_child_weight=min_child_weight, learning_rate=learning_rate,
+        monotone=monotone,
     ))
     predict_fn = jax.jit(partial(
         _predict_stage, max_depth=max_depth, n_bins=max_bins
@@ -693,6 +852,8 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
                     "gain": np.zeros(n_nodes, np.float32),
                 }
                 pos = np.zeros((n,), np.int32)
+                lo_d = np.full((1,), -np.inf, np.float32)
+                hi_d = np.full((1,), np.inf, np.float32)
                 for d in range(max_depth + 1):
                     nodes_d = 2 ** d
                     level_start = nodes_d - 1
@@ -707,13 +868,29 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
                     hg, hh = hist_fns[d](binned, g, h, pos, level_start)
                     # THE distributed step: one allreduce per level, on
                     # (nodes, F, bins+1) histograms — Rabit → ICI.
+                    # (Bounds need no reduction: they derive from the
+                    # already-reduced histograms, identically everywhere.)
                     stacked = np.stack([np.asarray(hg), np.asarray(hh)])
                     stacked = hist_reduce(stacked)
                     hg, hh = stacked[0], stacked[1]
-                    do_split, bf, bt, bml, leaf_w, gains = split_fn(
-                        hg, hh, feature_mask
-                    )
+                    do_split, bf, bt, bml, leaf_w, gains, wl, wr = \
+                        split_fn(
+                            hg, hh, feature_mask,
+                            lo_d if monotone is not None else None,
+                            hi_d if monotone is not None else None,
+                        )
                     do_split = np.asarray(do_split)
+                    if monotone is not None and d < max_depth:
+                        import jax.numpy as jnp
+
+                        c = monotone[np.asarray(bf)]
+                        lo_d, hi_d = (
+                            np.asarray(b) for b in _monotone_child_bounds(
+                                jnp.asarray(lo_d), jnp.asarray(hi_d),
+                                wl, wr, jnp.asarray(c),
+                                jnp.asarray(do_split),
+                            )
+                        )
                     if d == max_depth:
                         do_split = np.zeros_like(do_split)
                     sl = slice(level_start, level_start + nodes_d)

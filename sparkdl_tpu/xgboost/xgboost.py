@@ -77,6 +77,11 @@ _BOOSTER_PARAM_DEFS = {
                     "tree construction algorithm; this TPU implementation "
                     "always uses the histogram method."),
     "random_state": (0, TypeConverters.toInt, "random seed."),
+    "monotone_constraints": (None, TypeConverters.identity,
+                             "per-feature monotonicity: tuple/str/dict "
+                             "of {-1, 0, 1} (xgboost semantics); the "
+                             "trained forest is monotone in each "
+                             "constrained feature."),
     "num_class": (None, TypeConverters.toInt,
                   "number of classes for multi:softprob."),
     "eval_metric": (None, TypeConverters.toString,
@@ -106,7 +111,7 @@ _BOOSTER_PARAM_DEFS = {
 _IGNORED_PARAMS = {
     "n_jobs", "nthread", "verbosity", "silent", "booster",
     "enable_categorical", "max_cat_to_onehot", "predictor",
-    "sampling_method", "monotone_constraints", "interaction_constraints",
+    "sampling_method", "interaction_constraints",
     "importance_type", "device", "grow_policy", "max_leaves",
     "colsample_bylevel", "colsample_bynode", "max_delta_step",
 }
