@@ -327,20 +327,16 @@ def alltoall(tensor, splits=None, name=None):
 
 
 def reducescatter(tensor, op=None, name=None):
-    """Reduce-scatter along axis 0 (equal chunks)."""
+    """Reduce-scatter along axis 0 (equal chunks): one XLA
+    ``psum_scatter`` — each rank receives only its reduced chunk
+    (1/size the traffic of allreduce-then-slice)."""
     del name
     _state.require_initialized()
-    n = size()
     x = to_numpy(tensor)
-    if x.shape[0] % n:
-        raise ValueError(
-            f"reducescatter requires dim0 ({x.shape[0]}) divisible by size ({n})"
-        )
-    full = engine().reduce(
+    out = engine().scatter_reduce(
         np.asarray(x, order="C"), _resolve_op(None, op) if op else AVERAGE
     )
-    chunk = x.shape[0] // n
-    return from_numpy_like(full[rank() * chunk : (rank() + 1) * chunk], tensor)
+    return from_numpy_like(out, tensor)
 
 
 # -- capability probes (horovod API compat) ---------------------------------

@@ -101,6 +101,47 @@ class _CollectiveEngine:
         elif kind == "gather":
             # tiled all_gather along leading axis
             body = lambda x: jax.lax.all_gather(x, "hvd", axis=0, tiled=True)
+        elif kind in ("scatter_sum", "scatter_avg"):
+            # True reduce-scatter: ONE psum_scatter moves 1/n the bytes
+            # of the old allreduce-then-slice (each rank receives only
+            # its reduced chunk — XLA lowers to reduce-scatter on ICI).
+            def body(x):
+                out = jax.lax.psum_scatter(
+                    x[0], "hvd", scatter_dimension=0, tiled=True
+                )
+                if kind == "scatter_avg":
+                    out = out / jax.lax.axis_size("hvd")
+                return out
+        elif kind[0] == "bcast":
+            # True broadcast: binary-tree ppermute — the set of ranks
+            # holding root's block doubles each round (ppermute pairs
+            # must have unique sources, so one-to-many needs log2(n)
+            # rounds). n-1 block-sends total vs the old zeros+psum
+            # (a full allreduce: ~2(n-1)/n × the bytes on every link
+            # plus the reduction).
+            root = kind[1]
+            n = self._mesh.devices.size
+            rounds = []
+            span = 1
+            while span < n:
+                perm = [
+                    ((root + p) % n, (root + p + span) % n)
+                    for p in range(min(span, n - span))
+                ]
+                rounds.append((span, min(2 * span, n), perm))
+                span *= 2
+
+            def body(x):
+                import jax.numpy as jnp
+
+                blk = x[0]
+                p_rel = (jax.lax.axis_index("hvd") - root) % n
+                cur = blk
+                for lo, hi, perm in rounds:
+                    sent = jax.lax.ppermute(cur, "hvd", perm)
+                    is_recv = (p_rel >= lo) & (p_rel < hi)
+                    cur = jnp.where(is_recv, sent, cur)
+                return cur
         elif kind == "alltoall":
             # shard_map block (1, n*chunk, ...): exchange chunk j with
             # rank j in one collective (XLA all-to-all over ICI).
@@ -114,12 +155,17 @@ class _CollectiveEngine:
                 return out.reshape(blk.shape)[None]
         else:
             raise ValueError(kind)
-        # alltoall outputs stay partitioned (each rank receives its own
-        # slices); reductions/gathers replicate. The replication checker
-        # can't infer all_gather/all_to_all semantics — disable for those.
-        out_spec = P("hvd") if kind == "alltoall" else P()
+        # alltoall/reduce-scatter outputs stay partitioned (each rank
+        # receives its own slices); reductions/gathers/broadcasts
+        # replicate. The replication checker can't infer
+        # all_gather/all_to_all/ppermute/psum_scatter semantics —
+        # disable for those.
+        partitioned = kind in ("alltoall", "scatter_sum", "scatter_avg")
+        out_spec = P("hvd") if partitioned else P()
         extra = (
-            {"check_vma": False} if kind in ("gather", "alltoall") else {}
+            {"check_vma": False}
+            if partitioned or kind == "gather" or kind[0] == "bcast"
+            else {}
         )
         with self._lock:
             fn = self._fns.get(key)
@@ -273,12 +319,57 @@ class _CollectiveEngine:
         out = fn(self._to_global(x_np))
         return np.asarray(out.addressable_shards[0].data)[0]
 
+    def scatter_reduce(self, x_np, op):
+        """Reduce-scatter along axis 0 (dim0 divisible by size): each
+        rank receives its own reduced ``dim0/size`` chunk via ONE
+        ``psum_scatter`` — 1/size the interconnect bytes of
+        allreduce-then-slice. ``op`` ∈ {SUM, AVERAGE} (floats reduce
+        in-graph; integer averages truncate on host like :meth:`reduce`)."""
+        st = _state.state()
+        n = st.size
+        if x_np.shape[0] % n:
+            raise ValueError(
+                f"scatter_reduce requires dim0 ({x_np.shape[0]}) "
+                f"divisible by size ({n})"
+            )
+        chunk = x_np.shape[0] // n
+        if n == 1:
+            return x_np.copy()
+        if op not in (SUM, AVERAGE):
+            # min/max have no scatter form in XLA; full reduce + slice
+            full = self.reduce(x_np, op)
+            return full[st.rank * chunk:(st.rank + 1) * chunk]
+        orig_dtype = x_np.dtype
+        squeeze_bool = orig_dtype == np.bool_
+        if squeeze_bool:
+            # same semantics as reduce(): XLA would widen a bool psum
+            x_np = x_np.astype(np.uint8)
+        host_avg = op == AVERAGE and not _is_float_dtype(x_np.dtype)
+        kind = "scatter_avg" if op == AVERAGE and not host_avg \
+            else "scatter_sum"
+        fn = self._compiled(kind, x_np.shape, x_np.dtype)
+        out = np.asarray(
+            fn(self._to_global(x_np)).addressable_shards[0].data
+        )
+        assert out.shape[0] == chunk
+        if host_avg:
+            out = out.astype(np.float64) / n
+        if squeeze_bool:
+            out = out.astype(np.bool_)
+        else:
+            # XLA may canonicalize (f64->f32 without x64); the
+            # caller's dtype is the contract, as in reduce().
+            out = out.astype(orig_dtype, copy=False)
+        return out
+
     def broadcast(self, x_np, root_rank):
         st = _state.state()
         if st.size == 1:
             return x_np.copy()
-        contrib = x_np if st.rank == root_rank else np.zeros_like(x_np)
-        return self.reduce(contrib, SUM)
+        fn = self._compiled(
+            ("bcast", int(root_rank)), x_np.shape, x_np.dtype
+        )
+        return self._local_out(fn(self._to_global(x_np)))
 
     def barrier(self):
         self.reduce(np.zeros((1,), np.float32), SUM)

@@ -62,12 +62,19 @@ class MoEMLP(nn.Module):
         return moe_apply(x, gates, w_gate, w_up, w_down)
 
 
+def _topk_mask(probs, top_k):
+    """Exact top-k membership mask via the indices top_k returns —
+    a ``probs >= kth_value`` comparison would select more than
+    ``top_k`` experts on probability ties (near-uniform init)."""
+    _, idx = jax.lax.top_k(probs, top_k)           # (..., top_k)
+    hot = jax.nn.one_hot(idx, probs.shape[-1], dtype=probs.dtype)
+    return hot.sum(axis=-2)                        # (..., E) in {0,1}
+
+
 def gates_from_probs(probs, top_k):
     """Top-k gates from router probabilities, renormalized over the
     selected experts."""
-    top_vals, _ = jax.lax.top_k(probs, top_k)
-    thresh = top_vals[..., -1:]
-    gated = jnp.where(probs >= thresh, probs, 0.0)
+    gated = probs * _topk_mask(probs, top_k)
     return gated / jnp.maximum(gated.sum(axis=-1, keepdims=True), 1e-9)
 
 
@@ -84,8 +91,7 @@ def load_balance_loss(probs, top_k):
     gives ``top_k``; imbalance grows it toward ``E * top_k``."""
     n_experts = probs.shape[-1]
     flat = probs.reshape(-1, n_experts)
-    top_vals, _ = jax.lax.top_k(flat, top_k)
-    chosen = (flat >= top_vals[..., -1:]).astype(jnp.float32)
+    chosen = _topk_mask(flat, top_k).astype(jnp.float32)
     f = chosen.mean(axis=0)
     p = flat.mean(axis=0)
     return n_experts * jnp.sum(f * p)

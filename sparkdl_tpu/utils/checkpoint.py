@@ -4,10 +4,18 @@ state checkpoints ride orbax, the TPU-native answer, with the same
 save/restore surface the estimators use for models).
 
 Works with sharded (GSPMD) params: orbax restores to the same
-shardings when given an abstract target; in HorovodRunner gangs, rank 0
-coordinates (single-controller semantics are per-process here, so each
-process checkpoints only in single-process or pjit jobs; gang jobs
-should checkpoint from rank 0 — see :func:`should_save`).
+shardings when given an abstract target. Two distributed regimes are
+handled distinctly (see ``TrainCheckpointer.__init__``):
+
+- **HorovodRunner gangs** (``hvd.init()`` called): one jax world where
+  ``process_index == rank`` and state is replicated per rank. Rank 0
+  persists (:func:`should_save`); each rank's manager is pinned
+  process-local so orbax's cross-process barriers don't deadlock when
+  non-primary ranks skip the write.
+- **Multihost GSPMD pjit jobs** (multi-process world, no hvd gang):
+  arrays are sharded across processes, so ALL processes must
+  participate in each save; orbax's default cross-process coordination
+  is left in place.
 """
 
 import os
@@ -46,21 +54,39 @@ class TrainCheckpointer:
         a following save, and :meth:`close` all join the pending write
         first.
 
-        Gang semantics: HorovodRunner gangs are N independent
-        single-controller jax worlds (state replicated per rank), NOT
-        one multihost GSPMD world — so each rank's manager is pinned
-        process-local (orbax's cross-process barriers would otherwise
-        deadlock: the non-primary rank skips the write without entering
-        the barrier the primary waits in). Rank 0 persists
-        (:func:`should_save` gates :meth:`save`); any rank may
-        :meth:`restore`, ordered by the caller (``hvd.barrier()``
-        between a save and a dependent restore)."""
+        Gang semantics: a HorovodRunner gang is one jax world
+        (``hvd.init()`` calls ``jax.distributed.initialize``, so
+        ``process_index == rank``) with state REPLICATED per rank, so
+        each rank's manager is pinned process-local (orbax's
+        cross-process barriers would otherwise deadlock: the
+        non-primary rank skips the write without entering the barrier
+        the primary waits in). Rank 0 persists (:func:`should_save`
+        gates :meth:`save`); any rank may :meth:`restore`, ordered by
+        the caller (``hvd.barrier()`` between a save and a dependent
+        restore).
+
+        Multihost GSPMD pjit jobs (multi-process world WITHOUT an hvd
+        gang) keep orbax's default cross-process coordination: arrays
+        are sharded across processes, so every process must join each
+        save — pinning here would make each process its own primary
+        and corrupt/thin the write."""
         import orbax.checkpoint as ocp
+
+        from sparkdl_tpu.hvd import _state
 
         self._dir = os.path.abspath(directory)
         self._async = bool(async_save)
         os.makedirs(self._dir, exist_ok=True)
-        pidx = _process_index()
+        self._gang = gang = _state.state().initialized
+        if gang:
+            pidx = _process_index()
+            mp_options = ocp.options.MultiprocessingOptions(
+                primary_host=pidx,
+                active_processes={pidx},
+                barrier_sync_key_prefix=f"rank{pidx}",
+            )
+        else:
+            mp_options = ocp.options.MultiprocessingOptions()
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -68,13 +94,7 @@ class TrainCheckpointer:
                 # unsupported with active_processes pinned)
                 max_to_keep=max_to_keep, create=False,
                 enable_async_checkpointing=self._async,
-                multiprocessing_options=(
-                    ocp.options.MultiprocessingOptions(
-                        primary_host=pidx,
-                        active_processes={pidx},
-                        barrier_sync_key_prefix=f"rank{pidx}",
-                    )
-                ),
+                multiprocessing_options=mp_options,
             ),
         )
 
@@ -106,8 +126,9 @@ class TrainCheckpointer:
         """Gang non-writers: this manager's step bookkeeping was
         scanned at construction; rescan so steps rank 0 wrote since
         (or retention deleted since) are visible. Ordering between a
-        write and a dependent read is the caller's barrier."""
-        if _process_index() != 0:
+        write and a dependent read is the caller's barrier. (GSPMD
+        jobs write from every process — orbax keeps them in sync.)"""
+        if self._gang and _process_index() != 0:
             self._mgr.reload()
 
     def restore(self, step=None, target=None):

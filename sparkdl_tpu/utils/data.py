@@ -62,6 +62,12 @@ def shard_for_rank(arrays, rank=None, size=None, *, drop_last=True):
 
     leaves = jax.tree.leaves(arrays)
     n = leaves[0].shape[0]
+    if n < size:
+        raise ValueError(
+            f"cannot shard {n} rows across {size} ranks — every rank "
+            f"would train on an empty shard (pass size<=n or feed "
+            f"more data)"
+        )
     if drop_last:
         per = n // size
         lo, hi = rank * per, (rank + 1) * per

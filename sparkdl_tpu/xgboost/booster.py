@@ -672,7 +672,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     scale_pos_weight = float(p.pop("scale_pos_weight", 1.0))
     user_base_score = p.pop("base_score", None)
     seed = int(p.pop("random_state", p.pop("seed", 0)))
-    monotone = _parse_monotone(p.pop("monotone_constraints", None))
+    monotone_spec = p.pop("monotone_constraints", None)
     n_classes = int(p.pop("num_class", 0))
     eval_metric = p.pop("eval_metric", None) or _DEFAULT_METRIC[objective]
     p["max_depth"] = max_depth
@@ -680,16 +680,7 @@ def train(params, X, y, *, sample_weight=None, base_margin=None,
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
     n, f = X.shape
-    if monotone is not None:
-        if monotone.shape[0] > f:
-            raise ValueError(
-                f"monotone_constraints has {monotone.shape[0]} entries "
-                f"for {f} features"
-            )
-        if monotone.shape[0] < f:  # partial dict spec: rest unconstrained
-            monotone = np.pad(monotone, (0, f - monotone.shape[0]))
-        if not np.any(monotone):
-            monotone = None  # all-zero: unconstrained
+    monotone = _parse_monotone(monotone_spec, f)
     w = (np.ones(n, np.float32) if sample_weight is None
          else np.asarray(sample_weight, np.float32))
     if scale_pos_weight != 1.0:

@@ -30,6 +30,9 @@ def _allreduce_main(scale):
     # on scalar optimizer state like SGD/iteration).
     scalar = hvd.broadcast(np.asarray(np.int32(3 + hvd.rank())), root_rank=0)
     scalar_sum = hvd.allreduce(np.asarray(np.float32(1.0)), op=hvd.Sum)
+    # reducescatter: dim0 = size*2; each rank keeps its reduced chunk
+    rs_in = np.arange(hvd.size() * 2, dtype=np.float32) + hvd.rank()
+    rs = hvd.reducescatter(rs_in, op=hvd.Sum)
     from sparkdl_tpu.horovod import log_to_driver
 
     log_to_driver(f"rank {hvd.rank()} done")
@@ -42,6 +45,7 @@ def _allreduce_main(scale):
         "bcast": bcast.tolist(),
         "scalar_shapes": [np.shape(scalar), np.shape(scalar_sum)],
         "scalar_bcast": int(np.asarray(scalar)),
+        "reducescatter": rs.tolist(),
     }
 
 
@@ -58,6 +62,8 @@ def test_np_minus_two_gang(capfd):
     assert result["bcast"] == [7.0]  # root_rank=1 contributed 1*7
     assert result["scalar_shapes"] == [(), ()]  # 0-d stays 0-d
     assert result["scalar_bcast"] == 3  # rank 0's value
+    # rank 0's chunk of sum_r(arange(4)+r): [0+1, 1+2] over 2 ranks
+    assert result["reducescatter"] == [1.0, 3.0]
     out = capfd.readouterr().out
     assert "rank 0 done" in out  # log_to_driver surfaced on the driver
     assert "rank 1 done" in out
