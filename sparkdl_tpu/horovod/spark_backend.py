@@ -28,10 +28,16 @@ class SparkGangResult:
 
 
 def _barrier_main(payload_bytes, verbosity, control_addr, control_secret,
-                  worker_platform=None):
-    """Runs inside each barrier task (executor-side)."""
+                  worker_platform=None, pass_partition=False):
+    """Runs inside each barrier task (executor-side).
 
-    def run_partition(_):
+    ``pass_partition=True``: the task's partition rows are collected
+    into a pandas frame EXECUTOR-SIDE and passed to ``main`` as its
+    first positional arg — the partition-resident estimator data path
+    (reference ``xgboost.py:58-80``: each worker trains on its own
+    partition; the driver never materializes the dataset)."""
+
+    def run_partition(part_iter):
         import os
         import socket
 
@@ -91,6 +97,14 @@ def _barrier_main(payload_bytes, verbosity, control_addr, control_secret,
         # traceback on the driver, not an opaque Spark task error).
         from sparkdl_tpu.horovod._worker import worker_io
 
+        if pass_partition:
+            import pandas as pd
+
+            rows = list(part_iter)
+            partition_pdf = (
+                pd.DataFrame([r.asDict() for r in rows]) if rows else None
+            )
+
         out = []
         with worker_io(rank) as client:
             import sparkdl_tpu.hvd as hvd
@@ -99,7 +113,10 @@ def _barrier_main(payload_bytes, verbosity, control_addr, control_secret,
             if client is not None:
                 client.send_ready()
             user_main, kwargs = cloudpickle.loads(payload_bytes)
-            result = user_main(**kwargs)
+            if pass_partition:
+                result = user_main(partition_pdf, **kwargs)
+            else:
+                result = user_main(**kwargs)
             if hvd.rank() == 0:
                 out.append(cloudpickle.dumps(result))
         return out
@@ -107,17 +124,7 @@ def _barrier_main(payload_bytes, verbosity, control_addr, control_secret,
     return run_partition
 
 
-def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
-    """Launch the gang as a Spark barrier job; returns None when no
-    active SparkSession exists (caller falls back to the local gang)."""
-    spark = SparkSession.getActiveSession()
-    if spark is None:
-        return None
-    import cloudpickle
-
-    from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
-
-    sc = spark.sparkContext
+def _check_slots(sc, num_workers):
     # Fail fast if the cluster cannot host the gang (runner_base.py:56-58).
     # (Busy-slot WAITING is Spark's own scheduler behavior: a barrier
     # job with free total capacity queues until slots drain.)
@@ -129,6 +136,16 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
             f"HorovodRunner requested np={num_workers} but the cluster has "
             f"only {total_slots} task slots; failing fast."
         )
+
+
+def _run_barrier_job(barrier_rdd, num_workers, main, kwargs,
+                     driver_log_verbosity, pass_partition=False):
+    """Shared barrier-job machinery: control plane, payload shipping,
+    rank-tagged failure surfacing, rank-0 result return."""
+    import cloudpickle
+
+    from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
+
     import tempfile
 
     job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-spark-job-")
@@ -141,12 +158,12 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
     )
     try:
         payload = cloudpickle.dumps((main, kwargs))
-        rdd = sc.parallelize(range(num_workers), num_workers).barrier()
         try:
-            pickled = rdd.mapPartitions(
+            pickled = barrier_rdd.mapPartitions(
                 _barrier_main(payload, driver_log_verbosity, server.address,
                               server.secret,
-                              os.environ.get("SPARKDL_TPU_WORKER_PLATFORM"))
+                              os.environ.get("SPARKDL_TPU_WORKER_PLATFORM"),
+                              pass_partition=pass_partition)
             ).collect()
         except Exception as e:
             # Surface the rank-tagged tracebacks the workers shipped
@@ -168,3 +185,43 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
         return SparkGangResult(cloudpickle.loads(pickled[0]))
     finally:
         server.close()
+
+
+def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
+    """Launch the gang as a Spark barrier job; returns None when no
+    active SparkSession exists (caller falls back to the local gang)."""
+    spark = SparkSession.getActiveSession()
+    if spark is None:
+        return None
+    sc = spark.sparkContext
+    _check_slots(sc, num_workers)
+    rdd = sc.parallelize(range(num_workers), num_workers).barrier()
+    return _run_barrier_job(rdd, num_workers, main, kwargs,
+                            driver_log_verbosity)
+
+
+def maybe_launch_estimator_on_spark(dataset, num_workers, main, kwargs,
+                                    driver_log_verbosity,
+                                    force_repartition=False):
+    """Partition-resident estimator training (reference
+    ``xgboost.py:58-80``): the DataFrame is repartitioned to one
+    partition per worker when needed, and each barrier task extracts
+    ITS OWN partition's rows executor-side — the driver never
+    materializes the dataset (the round-2 path collected the full
+    frame with toPandas, defeating 'exceptionally large dataset'
+    workflows, reference ``xgboost.py:81-97``).
+
+    Returns None when no active SparkSession exists."""
+    spark = SparkSession.getActiveSession()
+    if spark is None:
+        return None
+    sc = spark.sparkContext
+    _check_slots(sc, num_workers)
+    if force_repartition or dataset.rdd.getNumPartitions() != num_workers:
+        # force_repartition also serves its contract role: reshuffle
+        # even when the partition count already matches (reference
+        # xgboost.py:72-80).
+        dataset = dataset.repartition(num_workers)
+    rdd = dataset.rdd.barrier()
+    return _run_barrier_job(rdd, num_workers, main, kwargs,
+                            driver_log_verbosity, pass_partition=True)

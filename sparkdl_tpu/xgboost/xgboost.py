@@ -362,6 +362,67 @@ def _fit_booster(params, X, y, w, base_margin, X_val, y_val,
     )
 
 
+def _partition_gang_main(partition_pdf, params, colspec, esr, verbose,
+                         callbacks, xgb_model, use_external_storage,
+                         storage_precision):
+    """Executor-side estimator worker: trains on the rows of THIS
+    barrier task's partition only (reference ``xgboost.py:58-64`` —
+    each worker trains on its partition-resident data; nothing is
+    collected to the driver)."""
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.ml.dataframe import extract_matrix
+    from sparkdl_tpu.xgboost import booster as B
+
+    hvd.init()  # idempotent: the barrier bootstrap already rendezvoused
+    rank = hvd.rank()
+    if partition_pdf is None or not len(partition_pdf):
+        raise ValueError(
+            f"rank {rank}: empty input partition (fewer rows than "
+            f"num_workers, or skewed partitioning) — lower num_workers "
+            f"or set force_repartition=True"
+        )
+    X = extract_matrix(partition_pdf, colspec["features"])
+    y = partition_pdf[colspec["label"]].to_numpy(np.float32)
+    w = (partition_pdf[colspec["weight"]].to_numpy(np.float32)
+         if colspec.get("weight") else None)
+    eval_set = None
+    if colspec.get("val"):
+        mask = partition_pdf[colspec["val"]].to_numpy(bool)
+        X_val, y_val = X[mask], y[mask]
+        X, y = X[~mask], y[~mask]
+        if w is not None:
+            w = w[~mask]
+        # Early stopping is deterministic only if every worker scores
+        # the IDENTICAL validation set — gather the per-partition val
+        # rows across the gang (val sets are small; training rows
+        # stay partition-resident).
+        X_val = hvd.allgather(X_val)
+        y_val = hvd.allgather(y_val)
+        eval_set = [(X_val, y_val)] if len(X_val) else None
+    if use_external_storage:
+        # Spill executor-side: each worker memory-maps only its own
+        # shard (reference xgboost.py:81-97 — this is the path the
+        # driver-collect design could never reach at scale).
+        import os
+        import tempfile
+
+        spill = os.path.join(
+            tempfile.mkdtemp(prefix="sparkdl-xgb-spill-"), "X.npy"
+        )
+        np.save(spill, np.round(X, storage_precision).astype(np.float32))
+        X = np.load(spill, mmap_mode="r")
+
+    bst = B.train(
+        params, np.asarray(X), y, sample_weight=w, eval_set=eval_set,
+        early_stopping_rounds=esr, verbose_eval=verbose and rank == 0,
+        hist_reduce=lambda a: hvd.allreduce(a, op=hvd.Sum),
+        callbacks=callbacks, xgb_model=xgb_model,
+    )
+    return bst if rank == 0 else None
+
+
 class _XgboostEstimator(Estimator, _XgboostParams, MLReadable, MLWritable):
     """Shared fit/persistence (real versions of reference
     ``xgboost.py:109-122``)."""
@@ -387,7 +448,90 @@ class _XgboostEstimator(Estimator, _XgboostParams, MLReadable, MLWritable):
             ].to_numpy(bool)
         return X, y, w, bm, val_mask
 
+    def _fit_partitioned_on_spark(self, dataset, num_workers):
+        """Distributed fit over partition-resident executor data;
+        returns None (caller falls back to the driver-collect path)
+        when no Spark backend is live."""
+        try:
+            from sparkdl_tpu.horovod.spark_backend import (
+                maybe_launch_estimator_on_spark,
+            )
+        except ImportError:
+            return None
+
+        if (self.isDefined(self.baseMarginCol)
+                and self.getOrDefault(self.baseMarginCol)):
+            raise ValueError(
+                "baseMarginCol is not available for distributed training "
+                "(num_workers > 1)."
+            )
+        weight = (self.getOrDefault(self.weightCol)
+                  if self.isDefined(self.weightCol) else None)
+        if self.getOrDefault(self.use_external_storage) and weight:
+            raise ValueError(
+                "weightCol/baseMarginCol do not work with "
+                "use_external_storage=True (reference xgboost.py:87)."
+            )
+
+        n_classes = 0
+        if self._is_classifier():
+            # Label cardinality via a distributed distinct — k values
+            # reach the driver, never the dataset.
+            label_col = self.getLabelCol()
+            vals = np.asarray(
+                [r[0] for r in dataset.select(label_col).distinct().collect()
+                 if r[0] is not None],
+                np.float32,
+            )
+            labels = np.unique(vals[~np.isnan(vals)])
+            n_classes = int(labels.size)
+            expected = np.arange(n_classes, dtype=labels.dtype)
+            if n_classes < 2 or not np.array_equal(labels, expected):
+                raise ValueError(
+                    "XgboostClassifier requires integer labels "
+                    f"0..k-1 with k>=2; got label values {labels.tolist()}"
+                )
+
+        colspec = {
+            "features": self.getFeaturesCol(),
+            "label": self.getLabelCol(),
+            "weight": weight,
+            "val": (self.getOrDefault(self.validationIndicatorCol)
+                    if self.isDefined(self.validationIndicatorCol) else None),
+        }
+        result = maybe_launch_estimator_on_spark(
+            dataset, num_workers, _partition_gang_main,
+            kwargs=dict(
+                params=self._booster_params(n_classes),
+                colspec=colspec,
+                esr=self.getOrDefault(self.early_stopping_rounds),
+                verbose=self.getOrDefault(self.verbose_eval),
+                callbacks=(self.getOrDefault(self.callbacks)
+                           if self.isDefined(self.callbacks) else None),
+                xgb_model=self.getOrDefault(self.xgb_model),
+                use_external_storage=self.getOrDefault(
+                    self.use_external_storage),
+                storage_precision=self.getOrDefault(
+                    self.external_storage_precision),
+            ),
+            driver_log_verbosity="log_callback_only",
+            force_repartition=bool(
+                self.getOrDefault(self.force_repartition)),
+        )
+        if result is None:
+            return None
+        model = self._model_class()(result.value)
+        self._copyValues(model)
+        return model
+
     def _fit(self, dataset):
+        from sparkdl_tpu.ml.dataframe import is_spark_df
+
+        num_workers = int(self.getOrDefault(self.num_workers))
+        if num_workers > 1 and is_spark_df(dataset):
+            model = self._fit_partitioned_on_spark(dataset, num_workers)
+            if model is not None:
+                return model
         pdf, _ = to_pandas(dataset)
         X, y, w, bm, val_mask = self._resolve_columns(pdf)
         if val_mask is not None:
