@@ -52,13 +52,19 @@ UNIT = "tokens/sec/chip"
 PEAK_FLOPS = float(os.environ.get("SPARKDL_TPU_PEAK_FLOPS", 197e12))
 
 
-def _fail(msg, rc=2, allow_stale=False):
-    """``allow_stale=True`` (backend unreachable/wedged — an
-    environment failure, not a code failure): emit the cached
-    last-good measurement if fresh enough (stale-but-real beats null;
-    the driver gate records the parsed value). A measured run that
-    CRASHES never falls back — that would mask real regressions —
-    and always exits nonzero with a null record."""
+def _fail(msg, rc=2, allow_stale=False, attach_cache=False):
+    """``allow_stale=True`` is reserved for the PRE-RUN probe failing
+    (backend unreachable/wedged before any measured code executed —
+    unambiguously an environment failure, not a code failure): emit
+    the cached last-good measurement (stale-but-real beats null; the
+    driver gate records the parsed value, and ``stale_age_s`` says how
+    old it is). Once the measured run has STARTED, no outcome — crash,
+    hang, timeout — may fall back with exit 0: a deadlocked collective
+    both hangs the run and wedges the lease, so a post-hoc probe
+    cannot distinguish env from code, and serving yesterday's number
+    for today's regression would defeat the gate. Those paths may at
+    most ``attach_cache`` the last-good value for context, with
+    ``value: null`` and a nonzero exit."""
     if allow_stale:
         cached = _read_cache()
         if cached is not None:
@@ -66,15 +72,29 @@ def _fail(msg, rc=2, allow_stale=False):
             cached["stale_reason"] = msg
             print(json.dumps(cached))
             sys.exit(0)
-    print(json.dumps({
+    rec = {
         "metric": METRIC, "value": None, "unit": UNIT,
         "vs_baseline": None, "error": msg,
-    }))
+    }
+    if attach_cache:
+        cached = _read_cache()
+        if cached is not None:
+            rec["cached_last_good"] = {
+                k: cached.get(k)
+                for k in ("value", "measured_at", "stale_age_s")
+            }
+    print(json.dumps(rec))
     sys.exit(rc)
 
 
+# The cache must span a round boundary (a committed mid-round
+# measurement serving the end-of-round driver run ~12-24h later), so
+# the age gate is wide and ADVISORY within the window: the record
+# carries ``stale_age_s`` so the reader can judge freshness instead of
+# the bench refusing to serve anything. Beyond the hard cap the value
+# is too old to stand in for "current performance" at all.
 CACHE_MAX_AGE_S = int(os.environ.get(
-    "SPARKDL_TPU_BENCH_CACHE_MAX_AGE", 24 * 3600))
+    "SPARKDL_TPU_BENCH_CACHE_MAX_AGE", 7 * 24 * 3600))
 
 
 def _read_cache():
@@ -87,8 +107,10 @@ def _read_cache():
 
         measured = calendar.timegm(time.strptime(
             rec["measured_at"], "%Y-%m-%dT%H:%M:%SZ"))
-        if time.time() - measured > CACHE_MAX_AGE_S:
+        age = time.time() - measured
+        if age > CACHE_MAX_AGE_S:
             return None
+        rec["stale_age_s"] = int(age)
         return rec
     except Exception:
         return None
@@ -342,13 +364,13 @@ def orchestrate():
         [sys.executable, here, "--run"], env, RUN_TIMEOUT_S
     )
     if rc is None:
-        # A timeout is ambiguous: wedged backend (env failure, stale
-        # cache applies) or hung code (regression, must NOT be
-        # masked). Discriminate with a fresh probe: if the backend
-        # answers now, the hang was ours.
-        re_platform, _ = attempt_probe()
+        # A run timeout can NOT be disambiguated after the fact: a
+        # deadlocked collective (code bug) wedges the lease exactly
+        # like an environment failure, so a re-probe failing proves
+        # nothing. Never serve the cache with exit 0 here — attach the
+        # last-good value for context only, value stays null.
         _fail(f"measured run timeout after {RUN_TIMEOUT_S}s", rc=3,
-              allow_stale=re_platform is None)
+              attach_cache=True)
     sys.stderr.write(err[-2000:])
     if rc != 0:
         _fail("measured run rc=%d: %s" % (rc, err.strip()[-400:]), rc=3)
@@ -367,8 +389,11 @@ def orchestrate():
     print(line)
 
 
+# Must exceed the worst-case LEGITIMATE bench runtime (probe budget
+# ~13 min + RUN_TIMEOUT 25 min ≈ 38 min), else a second instance can
+# kill a healthy first one mid-measurement.
 STALE_HOLDER_AGE_S = int(os.environ.get(
-    "SPARKDL_TPU_BENCH_STALE_AGE", 1800))
+    "SPARKDL_TPU_BENCH_STALE_AGE", 3600))
 
 
 def _proc_age_s(pid):
@@ -383,20 +408,55 @@ def _proc_age_s(pid):
         return None
 
 
-def _kill_own_stale(holders):
+def _holder_cwd(pid):
+    """The holder process's cwd, or None when unreadable (gone, or
+    not ours to inspect) — never kill on a guess."""
+    try:
+        return os.readlink(f"/proc/{pid}/cwd")
+    except OSError:
+        return None
+
+
+def _is_own_bench_script(script, pid=None, repo=None):
+    """True only for THIS repo's bench tooling: the repo-root bench.py
+    or a script under the repo's own benchmarks/ dir, matched on
+    absolute paths. A relative argv token is resolved against the
+    HOLDER's cwd (``/proc/<pid>/cwd``), never ours — a foreign
+    project's ``python bench.py`` run from its own directory must not
+    alias onto this repo's. Unresolvable means no match (never kill on
+    a guess)."""
+    if not script:
+        return False
+    repo = os.path.realpath(repo or os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isabs(script):
+        if pid is None:
+            return False
+        holder_cwd = _holder_cwd(pid)
+        if holder_cwd is None:
+            return False
+        script = os.path.join(holder_cwd, script)
+    # realpath BOTH sides: a symlinked checkout must still recognize
+    # its own wedged holders (whose /proc paths come back resolved).
+    script_abs = os.path.realpath(script)
+    return (script_abs == os.path.join(repo, "bench.py")
+            or script_abs.startswith(os.path.join(repo, "benchmarks") + os.sep))
+
+
+def _kill_own_stale(holders, _sleep=time.sleep):
     """Kill stale BENCH tooling wedged holding the plugin (a
     benchmarks/ script a prior round left behind, an abandoned bench
     child). Guard rails: never touch user jobs (a live HorovodRunner
-    gang also maps the plugin), and never touch anything younger than
-    STALE_HOLDER_AGE_S — probes/runs are bounded, so a young bench.py
-    holder is a live concurrent instance, not a wedge."""
+    gang also maps the plugin), only this repo's own scripts by
+    absolute path, and never anything younger than STALE_HOLDER_AGE_S
+    (> worst-case legitimate runtime) — a young bench.py holder is a
+    live concurrent instance, not a wedge. SIGTERM first so the victim
+    can release the lease cleanly; SIGKILL only if it lingers."""
     import signal
 
     for h in holders:
         pid_s = h.split()[1].rstrip(":")
         # Anchor the match to the EXECUTED SCRIPT (first argv token
-        # after the interpreter), not the whole cmdline — a user job
-        # merely mentioning benchmarks/ in its arguments must survive.
+        # after the interpreter), not the whole cmdline.
         try:
             with open(f"/proc/{pid_s}/cmdline") as f:
                 argv = [a for a in f.read().split("\0") if a]
@@ -407,12 +467,21 @@ def _kill_own_stale(holders):
             if a.endswith(".py"):
                 script = a
                 break
-        if script.endswith("bench.py") or "benchmarks/" in script:
+        if _is_own_bench_script(script, pid=pid_s):
             age = _proc_age_s(pid_s)
             if age is None or age < STALE_HOLDER_AGE_S:
                 continue
             try:
-                os.kill(int(pid_s), signal.SIGKILL)
+                pid = int(pid_s)
+                os.kill(pid, signal.SIGTERM)
+                for _ in range(10):
+                    _sleep(0.5)
+                    try:
+                        os.kill(pid, 0)
+                    except ProcessLookupError:
+                        break
+                else:
+                    os.kill(pid, signal.SIGKILL)
                 sys.stderr.write(
                     f"bench: killed stale holder {pid_s} "
                     f"(age {int(age)}s)\n")

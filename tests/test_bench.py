@@ -43,6 +43,194 @@ def test_bench_emits_single_json_line_on_cpu():
     assert out["platform"] == "cpu"
 
 
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cache_rec(value=1234.5, age_s=60):
+    import time
+
+    return {
+        "metric": "llama_lora_train_tokens_per_sec_per_chip",
+        "value": value,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "platform": "tpu",
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() - age_s)),
+    }
+
+
+class TestReadCache:
+    def test_missing_file_returns_none(self, tmp_path, monkeypatch):
+        b = _load_bench()
+        monkeypatch.setattr(b, "CACHE_PATH", str(tmp_path / "nope.json"))
+        assert b._read_cache() is None
+
+    def test_fresh_record_served_with_advisory_age(self, tmp_path,
+                                                   monkeypatch):
+        b = _load_bench()
+        p = tmp_path / "cache.json"
+        p.write_text(json.dumps(_cache_rec(age_s=7200)))
+        monkeypatch.setattr(b, "CACHE_PATH", str(p))
+        rec = b._read_cache()
+        assert rec is not None and rec["value"] == 1234.5
+        # the age gate is advisory within the window: the record says
+        # how old it is instead of the bench refusing to serve it
+        assert 7000 < rec["stale_age_s"] < 7600
+
+    def test_record_older_than_hard_cap_rejected(self, tmp_path,
+                                                 monkeypatch):
+        b = _load_bench()
+        p = tmp_path / "cache.json"
+        p.write_text(json.dumps(_cache_rec(age_s=8 * 24 * 3600)))
+        monkeypatch.setattr(b, "CACHE_PATH", str(p))
+        assert b._read_cache() is None
+
+    def test_wrong_metric_or_null_value_rejected(self, tmp_path,
+                                                 monkeypatch):
+        b = _load_bench()
+        p = tmp_path / "cache.json"
+        monkeypatch.setattr(b, "CACHE_PATH", str(p))
+        rec = _cache_rec()
+        rec["metric"] = "other_metric"
+        p.write_text(json.dumps(rec))
+        assert b._read_cache() is None
+        rec = _cache_rec(value=None)
+        p.write_text(json.dumps(rec))
+        assert b._read_cache() is None
+
+
+class TestFailPaths:
+    def test_probe_failure_serves_stale_cache_exit_zero(
+            self, tmp_path, monkeypatch, capsys):
+        b = _load_bench()
+        p = tmp_path / "cache.json"
+        p.write_text(json.dumps(_cache_rec()))
+        monkeypatch.setattr(b, "CACHE_PATH", str(p))
+        with pytest.raises(SystemExit) as ei:
+            b._fail("backend unavailable", allow_stale=True)
+        assert ei.value.code == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] == 1234.5
+        assert out["stale"] is True
+        assert "backend unavailable" in out["stale_reason"]
+
+    def test_run_timeout_never_exits_zero_even_with_cache(
+            self, tmp_path, monkeypatch, capsys):
+        # ADVICE r3 (high): a hung measured run must not be masked by
+        # yesterday's number — the cache may be ATTACHED for context
+        # but value stays null and the exit is nonzero.
+        b = _load_bench()
+        p = tmp_path / "cache.json"
+        p.write_text(json.dumps(_cache_rec()))
+        monkeypatch.setattr(b, "CACHE_PATH", str(p))
+        with pytest.raises(SystemExit) as ei:
+            b._fail("measured run timeout", rc=3, attach_cache=True)
+        assert ei.value.code == 3
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] is None
+        assert out["cached_last_good"]["value"] == 1234.5
+
+    def test_probe_failure_without_cache_is_null_nonzero(
+            self, tmp_path, monkeypatch, capsys):
+        b = _load_bench()
+        monkeypatch.setattr(b, "CACHE_PATH", str(tmp_path / "nope.json"))
+        with pytest.raises(SystemExit) as ei:
+            b._fail("backend unavailable", allow_stale=True)
+        assert ei.value.code == 2
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] is None
+
+
+class TestKillOwnStale:
+    def test_script_match_is_absolute_to_this_repo(self):
+        b = _load_bench()
+        assert b._is_own_bench_script(BENCH)
+        assert b._is_own_bench_script(
+            os.path.join(REPO, "benchmarks", "allreduce_bench.py"))
+        # the substring trap: an UNRELATED project's benchmarks/ dir
+        assert not b._is_own_bench_script("/home/u/proj/benchmarks/x.py")
+        assert not b._is_own_bench_script("/home/u/proj/bench.py")
+        assert not b._is_own_bench_script("")
+
+    def test_relative_argv_resolved_against_holder_cwd(self, monkeypatch):
+        """A foreign `python bench.py` run from ITS OWN directory must
+        not alias onto this repo's bench.py via OUR cwd."""
+        b = _load_bench()
+        # no pid: cannot resolve, never match
+        assert not b._is_own_bench_script("bench.py")
+        monkeypatch.setattr(
+            b, "_holder_cwd", lambda p: "/home/other/project")
+        assert not b._is_own_bench_script("bench.py", pid="123")
+        # holder genuinely running from this repo: match
+        monkeypatch.setattr(b, "_holder_cwd", lambda p: REPO)
+        assert b._is_own_bench_script("bench.py", pid="123")
+        # unreadable /proc cwd: never kill on a guess
+        monkeypatch.setattr(b, "_holder_cwd", lambda p: None)
+        assert not b._is_own_bench_script("bench.py", pid="123")
+
+    def test_sigterm_before_sigkill_and_age_guard(self, monkeypatch):
+        import signal
+        import time as _time
+
+        b = _load_bench()
+        kills = []
+        monkeypatch.setattr(
+            b.os, "kill",
+            lambda pid, sig: kills.append((pid, sig)) if sig else None)
+        # fake /proc: cmdline names our own bench.py, age is stale
+        monkeypatch.setattr(b, "_proc_age_s", lambda pid: 7200)
+        real_open = open
+
+        def fake_open(path, *a, **kw):
+            if path == "/proc/4242/cmdline":
+                import io
+
+                return io.StringIO(f"{sys.executable}\0{BENCH}\0")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", fake_open)
+        b._kill_own_stale(["pid 4242: python bench.py"], _sleep=lambda s: None)
+        # SIGTERM first; SIGKILL only because our fake never dies
+        # (os.kill(pid, 0) is recorded but raises nothing)
+        sigs = [s for _, s in kills if s]
+        assert sigs[0] == signal.SIGTERM
+        assert sigs[-1] == signal.SIGKILL
+
+        # young holder: untouched
+        kills.clear()
+        monkeypatch.setattr(b, "_proc_age_s", lambda pid: 60)
+        b._kill_own_stale(["pid 4242: python bench.py"], _sleep=lambda s: None)
+        assert kills == []
+
+    def test_foreign_script_never_killed(self, monkeypatch):
+        b = _load_bench()
+        kills = []
+        monkeypatch.setattr(
+            b.os, "kill", lambda pid, sig: kills.append((pid, sig)))
+        monkeypatch.setattr(b, "_proc_age_s", lambda pid: 7200)
+        real_open = open
+
+        def fake_open(path, *a, **kw):
+            if path == "/proc/777/cmdline":
+                import io
+
+                return io.StringIO(
+                    f"{sys.executable}\0/other/benchmarks/train.py\0")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", fake_open)
+        b._kill_own_stale(["pid 777: python /other/benchmarks/train.py"],
+                          _sleep=lambda s: None)
+        assert kills == []
+
+
 def test_bench_fails_fast_when_backend_unavailable():
     # an unknown platform name fails backend init on every host; the
     # orchestrator must emit an error JSON line and exit nonzero
