@@ -143,6 +143,22 @@ def main():
     job_dir = os.environ["SPARKDL_TPU_JOB_DIR"]
     payload_path = os.environ["SPARKDL_TPU_PAYLOAD"]
 
+    # Remote-exec'd workers (ssh transport): the boot stream arrives
+    # over stdin ("-") — control-plane secret first (argv/env on the
+    # ssh command line are world-readable in /proc; stdin is not),
+    # then the payload — and the driver's job dir doesn't exist on
+    # this machine, so make a local copy for the per-rank log. Only
+    # the secret LINE is read eagerly: the payload body can be GBs
+    # over a slow link, and draining it here would burn the gang
+    # start timeout that local workers (who open a file at step 5)
+    # never pay. The body waits in the pipe until after READY.
+    payload_from_stdin = payload_path == "-"
+    if payload_from_stdin and (
+            os.environ.get("SPARKDL_TPU_CONTROL_SECRET") == "stdin"):
+        secret = sys.stdin.buffer.readline().rstrip(b"\n")
+        os.environ["SPARKDL_TPU_CONTROL_SECRET"] = secret.decode()
+    os.makedirs(job_dir, exist_ok=True)
+
     # 1. Platform selection must happen before any JAX backend init.
     _state.ensure_jax_platform()
 
@@ -171,8 +187,12 @@ def main():
 
             from sparkdl_tpu.utils.profiler import maybe_trace_worker
 
-            with open(payload_path, "rb") as f:
-                user_main, kwargs = cloudpickle.load(f)
+            if payload_from_stdin:
+                user_main, kwargs = cloudpickle.loads(
+                    sys.stdin.buffer.read())
+            else:
+                with open(payload_path, "rb") as f:
+                    user_main, kwargs = cloudpickle.load(f)
             with maybe_trace_worker(rank):
                 result = user_main(**kwargs)
 

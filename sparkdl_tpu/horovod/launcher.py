@@ -32,6 +32,11 @@ import sys
 import tempfile
 import time
 
+from sparkdl_tpu.horovod.topology import HOSTS_ENV
+from sparkdl_tpu.hvd._state import COORD_ENV
+
+COORD_PORT_ENV = "SPARKDL_TPU_COORDINATOR_PORT"
+
 logger = logging.getLogger("HorovodRunner")
 
 
@@ -54,6 +59,8 @@ class SlotWaitTimeout(RuntimeError):
     telling the user it gave up."""
 
 START_TIMEOUT_ENV = "SPARKDL_TPU_START_TIMEOUT"
+REMOTE_SHELL_ENV = "SPARKDL_TPU_REMOTE_SHELL"
+REMOTE_PYTHON_ENV = "SPARKDL_TPU_REMOTE_PYTHON"
 NUM_SLOTS_ENV = "SPARKDL_TPU_NUM_SLOTS"
 WORKER_PLATFORM_ENV = "SPARKDL_TPU_WORKER_PLATFORM"
 SLOT_WAIT_TIMEOUT_ENV = "SPARKDL_TPU_SLOT_WAIT_TIMEOUT"
@@ -226,28 +233,46 @@ def claim_slots(n, total, timeout=None):
         time.sleep(0.2)
 
 
-def _resolve_num_workers(np_arg):
+def _resolve_num_workers(np_arg, placement=None):
     """Returns (num_workers, mode, total_slots); total_slots is None in
-    local mode (oversubscription allowed, no slot accounting). The one
-    probe here is reused for the slot claim — probing again at claim
-    time would double the 120s-budget subprocess and open a TOCTOU
-    window where a flaky probe shrinks the total below np."""
+    local mode (oversubscription allowed, no slot accounting). With a
+    hosts spec (``placement``), the cluster total is the spec's
+    declared slot count — the slots live on the task NODES (reference
+    runner_base.py:44-45), so probing only this machine's chips would
+    wrongly fail any np that exceeds the local count. The spec is
+    TRUSTED, deliberately: cross-checking its local entry against real
+    chips would re-introduce the 120s probe subprocess this path
+    exists to avoid, so a spec overstating a host's slots fails at
+    device-bind time instead (with that rank's log naming the chip).
+    Without a spec, the one local probe here is reused for the slot
+    claim — probing again at claim time would double the 120s-budget
+    subprocess and open a TOCTOU window where a flaky probe shrinks
+    the total below np."""
     if np_arg <= -2:
         # Local mode: spawn -np subprocesses on this host (reference
         # runner_base.py:48-53). No slot check: CPU oversubscription is
         # explicitly allowed there.
         return -np_arg, "local", None
+    slots = (placement.total_slots if placement is not None
+             else available_slots())
     if np_arg == 0:
         logger.warning(
             "HorovodRunner(np=0) is deprecated (reference README.md:57-61); "
             "using all available task slots."
         )
-        slots = available_slots()
         return slots, "cluster", slots
-    slots = available_slots()
     if np_arg > slots:
         # np exceeds the cluster TOTAL: fail fast, never wait
         # (reference runner_base.py:56-58).
+        if placement is not None:
+            # NUM_SLOTS_ENV is not consulted on this path — pointing
+            # users at it would send them in a circle.
+            raise SlotExhaustionError(
+                f"HorovodRunner requested np={np_arg} task slots but "
+                f"the {HOSTS_ENV} spec declares only {slots} in "
+                f"total; the job fails fast rather than wait (add "
+                f"hosts/slots to {HOSTS_ENV})."
+            )
         raise SlotExhaustionError(
             f"HorovodRunner requested np={np_arg} task slots but the host "
             f"has only {slots} in total; the job fails fast rather than "
@@ -305,6 +330,91 @@ def _worker_env(base_env, *, rank, size, coordinator, control_addr,
     return env
 
 
+# -- remote exec transport --------------------------------------------------
+#
+# A hosts spec naming machines other than this one (reference
+# runner_base.py:54-55 — slots live "on the task nodes") launches those
+# ranks through a remote shell, mpirun-style: ``ssh <host> env K=V ...
+# python -m sparkdl_tpu.horovod._worker`` with the rank's payload piped
+# over the connection's stdin (SPARKDL_TPU_PAYLOAD=-). Assumes a
+# homogeneous cluster: same python (override SPARKDL_TPU_REMOTE_PYTHON)
+# and same package layout (PYTHONPATH is forwarded). There is NO silent
+# fallback: if the transport is disabled or unavailable, the launch
+# fails with a typed error instead of oversubscribing this host.
+
+
+class RemoteTransportError(RuntimeError):
+    """A multi-host placement cannot be honored: the remote-exec
+    transport is disabled or no remote shell is available. Raised
+    instead of silently launching every rank locally."""
+
+
+def _resolve_remote_shell():
+    """The remote-exec command tokens (``["ssh", "-o", ...]``), or
+    raises. ``SPARKDL_TPU_REMOTE_SHELL`` overrides (a test rig points
+    it at a fake ssh; ``none`` disables remote exec entirely)."""
+    import shlex
+    import shutil
+
+    spec = os.environ.get(REMOTE_SHELL_ENV)
+    # empty/whitespace = the common way to "unset" a var: fall through
+    # to ssh detection rather than exec-ing the hostname as a program
+    if spec is not None and spec.strip():
+        if spec.strip().lower() == "none":
+            raise RemoteTransportError(
+                f"{REMOTE_SHELL_ENV}=none disables remote exec"
+            )
+        return shlex.split(spec)
+    if shutil.which("ssh") is None:
+        raise RemoteTransportError(
+            "no `ssh` on PATH and no SPARKDL_TPU_REMOTE_SHELL override"
+        )
+    # BatchMode: a gang launch must fail fast, never sit at a password
+    # prompt inside the start timeout.
+    return ["ssh", "-o", "BatchMode=yes"]
+
+
+def _remote_worker_cmd(shell_tokens, host, env, base_env, remote_python):
+    """Build the remote launch argv. Only the env DELTA the launcher
+    computed (gang wiring, TPU layout) plus PYTHONPATH crosses the
+    wire — the rest of this machine's environment is not meaningful on
+    the task node. Values are shell-quoted: ssh hands the command line
+    to the remote shell."""
+    import shlex
+
+    # Forward (a) the whole gang-config namespace — matching on the
+    # env DELTA alone silently drops vars whose computed value equals
+    # the driver's own env, e.g. an operator-pinned
+    # SPARKDL_TPU_COORDINATOR or exported TPU_PROCESS_BOUNDS — and
+    # (b) anything else the launcher computed fresh for this rank.
+    fwd = {
+        k: v for k, v in env.items()
+        if (k.startswith(("SPARKDL_TPU_", "TPU_"))
+            or k == "CLOUD_TPU_TASK_ID"
+            or base_env.get(k) != v)
+        and k != "XLA_FLAGS"
+    }
+    if base_env.get("PYTHONPATH"):
+        fwd.setdefault("PYTHONPATH", base_env["PYTHONPATH"])
+    # The payload file lives on the driver; the remote worker reads it
+    # from stdin (ssh forwards our stdin pipe).
+    fwd["SPARKDL_TPU_PAYLOAD"] = "-"
+    # The control-plane credential must NEVER ride the command line —
+    # argv is world-readable in /proc on both machines (and often
+    # logged by sshd) while the control plane listens beyond loopback
+    # for exactly these gangs. It rides stdin instead: first line of
+    # the boot stream, ahead of the payload.
+    fwd["SPARKDL_TPU_CONTROL_SECRET"] = "stdin"
+    # The driver's job dir path is meaningless remotely; the worker
+    # mkdirs its own copy for the per-rank log.
+    return (
+        list(shell_tokens)
+        + [host, "env"]
+        + [f"{k}={shlex.quote(v)}" for k, v in sorted(fwd.items())]
+        + [remote_python, "-m", "sparkdl_tpu.horovod._worker"]
+    )
+
+
 def _tail(path, n=40):
     try:
         with open(path, "r", errors="replace") as f:
@@ -335,7 +445,8 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
             return _launch_gang_once(
                 np, main, kwargs, driver_log_verbosity, per_rank_kwargs
             )
-        except (SlotExhaustionError, SlotProbeError, SlotWaitTimeout):
+        except (SlotExhaustionError, SlotProbeError, SlotWaitTimeout,
+                RemoteTransportError):
             raise  # typed, never retryable (cannot self-heal)
         except RuntimeError as e:
             if attempt >= max_restarts:
@@ -353,8 +464,10 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     import cloudpickle
 
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
+    from sparkdl_tpu.horovod.topology import Placement, is_local_host
 
-    num_workers, mode, total_slots = _resolve_num_workers(np)
+    spec_placement = Placement.from_env(os.environ)
+    num_workers, mode, total_slots = _resolve_num_workers(np, spec_placement)
     if per_rank_kwargs is not None and len(per_rank_kwargs) != num_workers:
         raise ValueError(
             f"per_rank_kwargs has {len(per_rank_kwargs)} entries for a "
@@ -376,19 +489,69 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         except ImportError:
             pass
 
+    # Remote-transport availability is knowable NOW — before the slot
+    # claim (which can wait minutes for busy slots) and before any
+    # payload serialization. Fail-fast philosophy: a CLUSTER gang
+    # whose RANKS land on other machines engages the remote transport
+    # or dies here, typed. Silently Popen-ing every rank locally would
+    # oversubscribe this host's chips while TPU_PROCESS_ADDRESSES
+    # points at machines never contacted. Derived from the launched
+    # ranks, not the whole spec: np=4 against "localhost:4,nodeB:4"
+    # fills only localhost and needs no transport (and must keep the
+    # control plane on loopback). LOCAL mode (np<=-2, "spawn -np
+    # subprocesses on this host", reference runner_base.py:48-53) is
+    # exempt by definition — a hosts spec there is the topology
+    # SIMULATION rig (placement env without placement).
+    gang_placement = spec_placement or Placement.single_host(num_workers)
+    remote_hosts = [] if mode == "local" else sorted({
+        gang_placement.host(r) for r in range(num_workers)
+        if not is_local_host(gang_placement.host(r))
+    })
+    remote_shell = remote_python = None
+    if remote_hosts:
+        try:
+            remote_shell = _resolve_remote_shell()
+        except RemoteTransportError as e:
+            raise RemoteTransportError(
+                f"hosts spec places ranks on remote host(s) "
+                f"{remote_hosts}, but remote exec is unavailable "
+                f"({e}). Refusing to launch the whole gang on this "
+                "host — that would oversubscribe its chips and "
+                "point TPU_PROCESS_ADDRESSES at machines that were "
+                "never contacted. Fix the transport or the "
+                f"{HOSTS_ENV} spec."
+            )
+        remote_python = os.environ.get(REMOTE_PYTHON_ENV, sys.executable)
+
     # Cluster gangs on this host share a slot registry: wait while
     # another job's gang holds slots, launch when ours free up
     # (reference runner_base.py:56-58 — waiting is the contract;
     # np > total already failed fast above, using the same probe).
+    # The registry tracks THIS machine's chips, so a hosts-spec gang
+    # claims only its locally-placed ranks — remote ranks consume
+    # remote slots, and claiming them here would starve concurrent
+    # local gangs for capacity this job isn't using.
     # Local mode (np<-1) deliberately skips this: oversubscription is
     # allowed there. ONE try/finally owns every resource from here —
     # a leaked claim counts as busy for this driver's whole lifetime.
     slot_claim = None
     if mode == "cluster":
-        slot_claim = claim_slots(num_workers, total_slots)
+        if spec_placement is not None:
+            n_local = sum(
+                1 for r in range(num_workers)
+                if is_local_host(spec_placement.host(r))
+            )
+            local_total = sum(
+                s for h, s in spec_placement.hosts if is_local_host(h)
+            )
+            if n_local:
+                slot_claim = claim_slots(n_local, local_total)
+        else:
+            slot_claim = claim_slots(num_workers, total_slots)
     server = None
     procs = []
     boot_logs = []
+    boot_paths = {}  # payload path -> staged secret+payload boot file
     try:
         job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-job-")
         payload_paths = []
@@ -431,19 +594,33 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         effective_verbosity = (
             "all" if mode == "local" else driver_log_verbosity
         )
+        platform = os.environ.get(WORKER_PLATFORM_ENV)
         server = ControlPlaneServer(
             num_workers,
             verbosity=effective_verbosity,
             log_path=os.path.join(job_dir, "job.log"),
+            # Remote workers dial back in: bind beyond loopback and
+            # advertise a routable address.
+            bind_host="0.0.0.0" if remote_hosts else "127.0.0.1",
         )
-        coordinator = f"127.0.0.1:{_free_port()}"
-        platform = os.environ.get(WORKER_PLATFORM_ENV)
-        from sparkdl_tpu.horovod.topology import Placement
-
-        gang_placement = (
-            Placement.from_env(os.environ)
-            or Placement.single_host(num_workers)
-        )
+        # jax.distributed's coordinator lives in RANK 0, so the
+        # rendezvous address must name rank 0's host, reachable from
+        # every worker. Operators behind NAT/DNS oddities can pin it.
+        coordinator = os.environ.get(COORD_ENV)
+        if not coordinator:
+            host0 = gang_placement.host(0)
+            if not remote_hosts:
+                # all ranks on this machine (incl. local-mode
+                # simulation of multi-host specs): loopback rendezvous
+                coordinator = f"127.0.0.1:{_free_port()}"
+            elif is_local_host(host0):
+                coordinator = (
+                    f"{server.address.rsplit(':', 1)[0]}:{_free_port()}")
+            else:
+                # Can't probe a free port on a remote machine; use a
+                # fixed well-known port there (override via env).
+                port = os.environ.get(COORD_PORT_ENV, "8998")
+                coordinator = f"{host0}:{port}"
 
         logger.info(
             "Launching HorovodRunner gang: %d worker(s), mode=%s, job_dir=%s",
@@ -464,12 +641,57 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 os.path.join(job_dir, f"rank-{r}.log"), "ab", buffering=0
             )
             boot_logs.append(boot_log)
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "sparkdl_tpu.horovod._worker"],
-                env=env,
-                stdout=boot_log,
-                stderr=subprocess.STDOUT,
-            ))
+            host_r = gang_placement.host(r)
+            # remote_hosts is [] in local mode (simulation rig): every
+            # rank spawns locally no matter what the spec names
+            if host_r not in remote_hosts:
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "sparkdl_tpu.horovod._worker"],
+                    env=env,
+                    stdout=boot_log,
+                    stderr=subprocess.STDOUT,
+                ))
+            else:
+                cmd = _remote_worker_cmd(
+                    remote_shell, host_r, env, os.environ, remote_python
+                )
+                # Boot stream: secret line + payload bytes, staged in
+                # a driver-local file so the kernel (not this loop)
+                # streams it — a PIPE write would block on large
+                # payloads until the remote end drains. Staged ONCE
+                # per unique payload (a shared payload re-copied per
+                # rank would write rank-count × GBs); each rank's open
+                # gets its own fd/offset. Unlinked in the finally:
+                # job_dir outlives the job for postmortems, the secret
+                # must not outlive launch.
+                boot_path = boot_paths.get(payload_paths[r])
+                if boot_path is None:
+                    import shutil
+
+                    boot_path = os.path.join(job_dir, f"boot-{r}.bin")
+                    with open(boot_path, "wb") as bf:
+                        bf.write(server.secret.encode() + b"\n")
+                        with open(payload_paths[r], "rb") as pf:
+                            shutil.copyfileobj(pf, bf)
+                    boot_paths[payload_paths[r]] = boot_path
+                with open(boot_path, "rb") as boot_in:
+                    procs.append(subprocess.Popen(
+                        cmd,
+                        stdin=boot_in,
+                        stdout=boot_log,
+                        stderr=subprocess.STDOUT,
+                    ))
+
+        # The spawned children hold their own fds on the boot streams:
+        # unlink the secret-bearing files NOW, before the (possibly
+        # hours-long) job runs — the finally's unlink is only the
+        # backstop for exceptions inside the spawn loop itself.
+        for bp in boot_paths.values():
+            try:
+                os.unlink(bp)
+            except OSError:
+                pass
+        boot_paths.clear()
 
         def _fail(reason, exit_codes=None):
             excs = server.exceptions
@@ -563,6 +785,13 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             )
         return cloudpickle.loads(result_bytes)
     finally:
+        for bp in boot_paths.values():
+            # spawned children hold their own fds; the secret-bearing
+            # file must not persist in the postmortem-kept job_dir
+            try:
+                os.unlink(bp)
+            except OSError:
+                pass
         for p in procs:
             if p.poll() is None:
                 p.kill()  # a failed gang must not wedge the pod

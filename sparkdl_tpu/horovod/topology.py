@@ -21,6 +21,7 @@ the Spark barrier backend derives its Placement from the barrier task
 infos instead of an env spec (executors already know their hosts).
 """
 
+import functools
 import os
 
 HOSTS_ENV = "SPARKDL_TPU_HOSTS"
@@ -130,12 +131,87 @@ class Placement:
                 "TPU_PROCESS_PORT": str(
                     TPU_PORT_BASE + self.local_rank(rank)
                 ),
+                # Loopback aliases in the spec must be rewritten to a
+                # routable address here: a remote rank dialing
+                # "localhost" for its driver-host peers connects to
+                # ITSELF and the mesh init hangs.
                 "TPU_PROCESS_ADDRESSES": ",".join(
-                    f"{self.host(r)}:{TPU_PORT_BASE + self.local_rank(r)}"
+                    f"{_addressable(self.host(r))}"
+                    f":{TPU_PORT_BASE + self.local_rank(r)}"
                     for r in range(self.total_slots)
                 ),
             })
         return env
+
+
+@functools.lru_cache(maxsize=64)
+def _addressable(host):
+    """A form of ``host`` that PEER machines can dial: loopback
+    aliases become this machine's routable IP; anything else (a DNS
+    name, a NIC address) passes through. Cached: the peer list is
+    rebuilt per rank, and a multi-NIC driver whose default route
+    flaps mid-launch must not hand different ranks different peer
+    addresses. If no routable address can be determined at all, the
+    alias passes through unchanged — same-host peers still work, and
+    remote peers fail with a connect error naming the address rather
+    than a raw resolver traceback at env-construction time."""
+    if host in ("localhost", "127.0.0.1", "::1"):
+        from sparkdl_tpu.horovod.control_plane import routable_host_ip
+
+        try:
+            return routable_host_ip()
+        except OSError:
+            return host
+    return host
+
+
+@functools.lru_cache(maxsize=256)
+def is_local_host(host):
+    """True when ``host`` names THIS machine: loopback, our hostname /
+    fqdn, or an address that resolves onto one of this host's own
+    addresses. Used by the launcher to decide local ``Popen`` vs the
+    remote-exec transport — a multi-host spec must never silently
+    collapse onto one machine.
+
+    Cached: the launcher asks per rank, and repeating blocking DNS
+    lookups inside the start-timeout window is waste — worse, a flaky
+    resolver answering differently between two calls could wire the
+    gang for remote transport yet Popen a rank locally."""
+    import socket
+
+    if host in ("localhost", "127.0.0.1", "::1"):
+        return True
+    names = {socket.gethostname()}
+    try:
+        names.add(socket.getfqdn())
+    except OSError:
+        pass
+    if host in names:
+        return True
+    try:
+        host_ips = {ai[4][0] for ai in socket.getaddrinfo(host, None)}
+    except OSError:
+        # Unresolvable names are NOT local: better to fail loudly in
+        # the remote transport than to quietly launch locally.
+        return False
+    if any(ip.startswith("127.") or ip == "::1" for ip in host_ips):
+        return True
+    local_ips = set()
+    for n in names:
+        try:
+            local_ips |= {ai[4][0] for ai in socket.getaddrinfo(n, None)}
+        except OSError:
+            pass
+    # Hostname resolution alone misses NIC addresses on stock
+    # Debian-style /etc/hosts (hostname -> 127.0.1.1): a spec naming
+    # this driver by its real IP must still classify as local.
+    try:
+        from sparkdl_tpu.horovod.control_plane import routable_host_ip
+
+        local_ips.add(routable_host_ip())
+    except OSError:
+        pass
+    return bool(host_ips & local_ips)
 
 
 def placement_from_task_hosts(host_of_rank):

@@ -1,0 +1,169 @@
+"""Remote-exec transport tests: a hosts spec naming machines other
+than this one must launch those ranks through the remote shell
+(mpirun-style ssh, reference ``runner_base.py:54-55`` — slots live on
+the task NODES), or refuse loudly. The round-3 verdict's failure mode
+— a "multi-host" gang silently collapsing into local processes — is
+the regression these tests pin.
+
+The transport is validated with a fake ssh (``SPARKDL_TPU_REMOTE_SHELL``)
+that records the host it was asked to contact and then execs the
+command locally, replicating ssh's join-and-remote-shell semantics —
+so the whole path (env marshalling, shell quoting, stdin payload
+delivery, routable control plane) runs for real without an sshd.
+"""
+
+import os
+import socket
+import sys
+
+import pytest
+
+from sparkdl import HorovodRunner
+from sparkdl_tpu.horovod.launcher import (
+    RemoteTransportError,
+    _remote_worker_cmd,
+    _resolve_remote_shell,
+)
+from sparkdl_tpu.horovod.topology import is_local_host
+
+
+def _gang_main():
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+
+    hvd.init()
+    total = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum)
+    return {"size": hvd.size(), "sum": total.tolist()}
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestIsLocalHost:
+    def test_loopback_and_own_names_are_local(self):
+        assert is_local_host("localhost")
+        assert is_local_host("127.0.0.1")
+        assert is_local_host("::1")
+        assert is_local_host(socket.gethostname())
+
+    def test_unresolvable_host_is_not_local(self):
+        # unresolvable must mean NOT local: fail loudly in the
+        # transport rather than quietly launch on this machine
+        assert not is_local_host("no-such-host-deadbeef.invalid")
+
+
+class TestRemoteCommand:
+    def test_forwards_env_delta_and_stdin_payload(self):
+        base = {"HOME": "/root", "PYTHONPATH": "/repo:/site",
+                "UNTOUCHED": "x"}
+        env = dict(base)
+        env["SPARKDL_TPU_RANK"] = "3"
+        env["SPARKDL_TPU_PAYLOAD"] = "/tmp/job/payload-3.pkl"
+        cmd = _remote_worker_cmd(
+            ["ssh", "-o", "BatchMode=yes"], "hostB", env, base, "python3"
+        )
+        assert cmd[:4] == ["ssh", "-o", "BatchMode=yes", "hostB"]
+        assert cmd[4] == "env"
+        assert cmd[-3:] == ["python3", "-m", "sparkdl_tpu.horovod._worker"]
+        pairs = cmd[5:-3]
+        assert "SPARKDL_TPU_RANK=3" in pairs
+        # payload is re-pointed at stdin, not the driver-local path
+        assert "SPARKDL_TPU_PAYLOAD=-" in pairs
+        assert not any(p.startswith("SPARKDL_TPU_PAYLOAD=/tmp") for p in pairs)
+        # PYTHONPATH crosses (homogeneous cluster); unrelated env doesn't
+        assert any(p.startswith("PYTHONPATH=") for p in pairs)
+        assert not any(p.startswith("UNTOUCHED=") for p in pairs)
+        assert not any(p.startswith("HOME=") for p in pairs)
+
+    def test_secret_never_on_the_command_line(self):
+        """argv is world-readable in /proc on both machines while the
+        control plane listens beyond loopback — the credential must
+        ride the stdin boot stream, with only a marker in argv."""
+        base = {}
+        env = {"SPARKDL_TPU_CONTROL_SECRET": "deadbeef" * 8,
+               "SPARKDL_TPU_RANK": "1"}
+        cmd = _remote_worker_cmd([], "h", env, base, "python3")
+        joined = " ".join(cmd)
+        assert "deadbeef" not in joined
+        assert "SPARKDL_TPU_CONTROL_SECRET=stdin" in cmd
+
+    def test_values_are_shell_quoted(self):
+        base = {}
+        env = {"SPARKDL_TPU_JOB_DIR": "/tmp/a b;$(rm -rf ~)"}
+        cmd = _remote_worker_cmd([], "h", env, base, "python3")
+        joined = " ".join(cmd)
+        # the remote shell must see the value inside single quotes,
+        # where $(...) does not expand
+        assert "SPARKDL_TPU_JOB_DIR='/tmp/a b;$(rm -rf ~)'" in joined
+
+    def test_resolve_none_disables(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_TPU_REMOTE_SHELL", "none")
+        with pytest.raises(RemoteTransportError):
+            _resolve_remote_shell()
+
+
+def test_multi_host_spec_refused_without_transport(monkeypatch):
+    """No silent local launch: remote hosts + no transport = typed
+    error naming the hosts, before any worker spawns."""
+    monkeypatch.setenv("SPARKDL_TPU_HOSTS",
+                       "otherhost-deadbeef.invalid:2")
+    monkeypatch.setenv("SPARKDL_TPU_REMOTE_SHELL", "none")
+    monkeypatch.setenv("SPARKDL_TPU_NUM_SLOTS", "2")
+    with pytest.raises(RemoteTransportError, match="otherhost-deadbeef"):
+        HorovodRunner(np=2).run(_gang_main)
+
+
+@pytest.mark.gang
+def test_np_filling_only_local_hosts_needs_no_transport(monkeypatch):
+    """Hosts fill in order (reference runner_base.py:44-45): np=2
+    against 'localhost:2,remote:2' lands every rank locally, so the
+    gang must launch without any transport — and without widening the
+    control plane beyond loopback."""
+    monkeypatch.setenv("SPARKDL_TPU_HOSTS",
+                       "localhost:2,otherhost-deadbeef.invalid:2")
+    monkeypatch.setenv("SPARKDL_TPU_REMOTE_SHELL", "none")
+    result = HorovodRunner(np=2).run(_gang_main)
+    assert result["size"] == 2
+    assert result["sum"] == [2.0, 2.0]
+
+
+@pytest.mark.gang
+def test_remote_transport_fake_ssh(monkeypatch, tmp_path):
+    """2-rank gang across two 'remote' hosts via the fake ssh: both
+    hosts are contacted through the transport, the payload arrives
+    over stdin, and the gang's collectives produce correct values."""
+    contacted = tmp_path / "contacted.log"
+    fake = tmp_path / "fakessh"
+    # ssh semantics: argv[1] is the host; the rest joins into one
+    # command line handed to the remote shell.
+    fake.write_text(
+        "#!/bin/sh\n"
+        f'echo "$1" >> {contacted}\n'
+        'shift\n'
+        'exec sh -c "$*"\n'
+    )
+    fake.chmod(0o755)
+    monkeypatch.setenv("SPARKDL_TPU_HOSTS",
+                       "fakeremote-a.invalid:1,fakeremote-b.invalid:1")
+    monkeypatch.setenv("SPARKDL_TPU_REMOTE_SHELL", str(fake))
+    monkeypatch.setenv("SPARKDL_TPU_REMOTE_PYTHON", sys.executable)
+    # NO SPARKDL_TPU_NUM_SLOTS: the hosts spec itself declares the
+    # cluster total (2 slots on 2 nodes) — slot resolution must not
+    # probe this machine's chips and reject np=2.
+    # rank 0's host is 'remote', so the launcher would pick the fixed
+    # coordinator port on it; pin the rendezvous locally instead
+    # (everything actually runs on this machine).
+    monkeypatch.setenv("SPARKDL_TPU_COORDINATOR",
+                       f"127.0.0.1:{_free_port()}")
+
+    result = HorovodRunner(np=2).run(_gang_main)
+    assert result["size"] == 2
+    assert result["sum"] == [2.0, 2.0]
+    hosts = set(contacted.read_text().split())
+    assert hosts == {"fakeremote-a.invalid", "fakeremote-b.invalid"}
