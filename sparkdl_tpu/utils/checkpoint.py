@@ -69,34 +69,60 @@ class TrainCheckpointer:
         gang) keep orbax's default cross-process coordination: arrays
         are sharded across processes, so every process must join each
         save — pinning here would make each process its own primary
-        and corrupt/thin the write."""
-        import orbax.checkpoint as ocp
+        and corrupt/thin the write.
 
-        from sparkdl_tpu.hvd import _state
-
+        The regime is decided LAZILY at the first save/restore, not at
+        construction: a checkpointer built before ``hvd.init()`` in a
+        gang worker would otherwise latch the GSPMD branch, and its
+        first rank-0-only save would deadlock in orbax's cross-process
+        barrier — exactly the failure the pinning exists to prevent."""
         self._dir = os.path.abspath(directory)
         self._async = bool(async_save)
+        self._max_to_keep = max_to_keep
         os.makedirs(self._dir, exist_ok=True)
-        self._gang = gang = _state.state().initialized
-        if gang:
-            pidx = _process_index()
-            mp_options = ocp.options.MultiprocessingOptions(
-                primary_host=pidx,
-                active_processes={pidx},
-                barrier_sync_key_prefix=f"rank{pidx}",
+        self._mgr_instance = None
+        self._gang = None
+
+    @property
+    def _mgr(self):
+        from sparkdl_tpu.hvd import _state
+
+        if (self._mgr_instance is not None and not self._gang
+                and _state.state().initialized):
+            # hvd.init() ran AFTER the manager first materialized
+            # (e.g. a pre-init latest_step() probed for a resume
+            # point): rebuild with gang pinning, or the next
+            # rank-0-only save deadlocks in orbax's cross-process
+            # barrier. The uninitialized→initialized transition only
+            # happens once, and only in a then-single-process world,
+            # so the close is barrier-free.
+            self._mgr_instance.close()
+            self._mgr_instance = None
+        if self._mgr_instance is None:
+            import orbax.checkpoint as ocp
+
+            self._gang = gang = _state.state().initialized
+            if gang:
+                pidx = _process_index()
+                mp_options = ocp.options.MultiprocessingOptions(
+                    primary_host=pidx,
+                    active_processes={pidx},
+                    barrier_sync_key_prefix=f"rank{pidx}",
+                )
+            else:
+                mp_options = ocp.options.MultiprocessingOptions()
+            self._mgr_instance = ocp.CheckpointManager(
+                self._dir,
+                options=ocp.CheckpointManagerOptions(
+                    # the root dir is created in __init__ (orbax's
+                    # create=True is unsupported with active_processes
+                    # pinned)
+                    max_to_keep=self._max_to_keep, create=False,
+                    enable_async_checkpointing=self._async,
+                    multiprocessing_options=mp_options,
+                ),
             )
-        else:
-            mp_options = ocp.options.MultiprocessingOptions()
-        self._mgr = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(
-                # the root dir is created above (orbax's create=True is
-                # unsupported with active_processes pinned)
-                max_to_keep=max_to_keep, create=False,
-                enable_async_checkpointing=self._async,
-                multiprocessing_options=mp_options,
-            ),
-        )
+        return self._mgr_instance
 
     def save(self, step, state, force=False):
         """state: any pytree (e.g. {'params': ..., 'opt_state': ...}).
@@ -128,8 +154,9 @@ class TrainCheckpointer:
         (or retention deleted since) are visible. Ordering between a
         write and a dependent read is the caller's barrier. (GSPMD
         jobs write from every process — orbax keeps them in sync.)"""
+        mgr = self._mgr  # materialize first (decides the regime)
         if self._gang and _process_index() != 0:
-            self._mgr.reload()
+            mgr.reload()
 
     def restore(self, step=None, target=None):
         """Restore a step (default latest). Pass ``target`` (a pytree of
@@ -156,4 +183,5 @@ class TrainCheckpointer:
         return self._mgr.restore(step)
 
     def close(self):
-        self._mgr.close()
+        if self._mgr_instance is not None:
+            self._mgr_instance.close()

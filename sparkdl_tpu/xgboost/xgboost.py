@@ -377,11 +377,21 @@ def _partition_gang_main(partition_pdf, params, colspec, esr, verbose,
 
     hvd.init()  # idempotent: the barrier bootstrap already rendezvoused
     rank = hvd.rank()
-    if partition_pdf is None or not len(partition_pdf):
+    n_rows = 0 if partition_pdf is None else len(partition_pdf)
+    # Fast-fail on skew, SYMMETRICALLY: every rank reports its row
+    # count through one tiny allgather BEFORE any data-dependent
+    # collective, so an empty partition aborts the whole gang at once.
+    # (The naive alternative — the empty rank raising unilaterally —
+    # leaves its peers blocked in the histogram allreduce until the
+    # control plane tears the gang down: a slow, timeout-shaped
+    # failure instead of an immediate typed one.)
+    counts = hvd.allgather(np.array([[n_rows]], np.int64))[:, 0]
+    if (counts == 0).any():
+        empty = [int(r) for r in np.nonzero(counts == 0)[0]]
         raise ValueError(
-            f"rank {rank}: empty input partition (fewer rows than "
-            f"num_workers, or skewed partitioning) — lower num_workers "
-            f"or set force_repartition=True"
+            f"empty input partition(s) at rank(s) {empty} (fewer rows "
+            f"than num_workers, or skewed partitioning) — lower "
+            f"num_workers or set force_repartition=True"
         )
     X = extract_matrix(partition_pdf, colspec["features"])
     y = partition_pdf[colspec["label"]].to_numpy(np.float32)
@@ -396,8 +406,28 @@ def _partition_gang_main(partition_pdf, params, colspec, esr, verbose,
             w = w[~mask]
         # Early stopping is deterministic only if every worker scores
         # the IDENTICAL validation set — gather the per-partition val
-        # rows across the gang (val sets are small; training rows
-        # stay partition-resident).
+        # rows across the gang (training rows stay partition-resident).
+        # Guard rail: the gather replicates the val set num_workers×,
+        # on the very path built for exceptionally large datasets
+        # (reference xgboost.py:81-97) — warn before it gets expensive.
+        warn_bytes = int(os.environ.get(
+            "SPARKDL_TPU_VAL_GATHER_WARN_BYTES", 256 << 20))
+        # float64, not int64: the collective canonicalizes ints to 32
+        # bits (x64 off), and a >2 GiB total wrapping negative would
+        # mute the guard in exactly the huge-data case it exists for
+        total_val = int(hvd.allreduce(
+            np.array([float(X_val.nbytes + y_val.nbytes)], np.float64),
+            op=hvd.Sum)[0])
+        if total_val * hvd.size() > warn_bytes:
+            logger.warning(
+                "validationIndicatorCol selects ~%.1f MB of rows; "
+                "gathering them to all %d workers replicates ~%.1f MB "
+                "for deterministic early stopping. Shrink the "
+                "validation fraction, or raise "
+                "SPARKDL_TPU_VAL_GATHER_WARN_BYTES to silence this.",
+                total_val / 2**20, hvd.size(),
+                total_val * hvd.size() / 2**20,
+            )
         X_val = hvd.allgather(X_val)
         y_val = hvd.allgather(y_val)
         eval_set = [(X_val, y_val)] if len(X_val) else None
@@ -405,7 +435,6 @@ def _partition_gang_main(partition_pdf, params, colspec, esr, verbose,
         # Spill executor-side: each worker memory-maps only its own
         # shard (reference xgboost.py:81-97 — this is the path the
         # driver-collect design could never reach at scale).
-        import os
         import tempfile
 
         spill = os.path.join(
@@ -528,10 +557,27 @@ class _XgboostEstimator(Estimator, _XgboostParams, MLReadable, MLWritable):
         from sparkdl_tpu.ml.dataframe import is_spark_df
 
         num_workers = int(self.getOrDefault(self.num_workers))
-        if num_workers > 1 and is_spark_df(dataset):
-            model = self._fit_partitioned_on_spark(dataset, num_workers)
-            if model is not None:
-                return model
+        if num_workers > 1:
+            model = None
+            if is_spark_df(dataset):
+                model = self._fit_partitioned_on_spark(dataset, num_workers)
+                if model is not None:
+                    return model
+                reason = ("no live SparkSession / barrier backend for "
+                          "this DataFrame")
+            else:
+                reason = "the input is not a Spark DataFrame"
+            # Never change semantics silently (fail-fast philosophy,
+            # reference runner_base.py:56-58): the user asked for a
+            # num_workers-way partition-resident fit and is about to
+            # get single-node driver-collect training instead.
+            logger.warning(
+                "num_workers=%d requested but distributed training is "
+                "unavailable (%s); falling back to SINGLE-NODE "
+                "driver-collect training. The whole dataset will be "
+                "materialized on this machine.",
+                num_workers, reason,
+            )
         pdf, _ = to_pandas(dataset)
         X, y, w, bm, val_mask = self._resolve_columns(pdf)
         if val_mask is not None:

@@ -63,6 +63,36 @@ def test_async_checkpoint_roundtrip(tmp_path):
         ckpt.close()
 
 
+def test_checkpoint_regime_decided_at_first_use_not_construction(
+        tmp_path, monkeypatch):
+    """ADVICE r3: a checkpointer constructed BEFORE hvd.init() in a
+    gang worker must still take the gang (process-local pinned) branch
+    at its first save — latching the GSPMD regime at construction
+    deadlocks the first rank-0-only save in orbax's barrier."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.hvd import _state
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    # construction happens while the shim is uninitialized...
+    _state.shutdown()
+    ckpt = TrainCheckpointer(str(tmp_path / "lazy"))
+    assert ckpt._gang is None  # regime not decided yet
+    # ...a pre-init READ must not poison the regime either (a worker
+    # probing for a resume point before its own hvd.init())...
+    assert ckpt.latest_step() is None
+    assert ckpt._gang is False  # latched non-gang for now
+    # ...then the worker calls hvd.init() (single-process gang here)
+    _state.init()
+    try:
+        assert ckpt.save(0, {"w": jnp.ones(3)})
+        assert ckpt._gang is True  # re-latched at the transition
+        assert ckpt.latest_step() == 0
+    finally:
+        ckpt.close()
+        _state.shutdown()
+
+
 def test_checkpoint_restore_empty_raises(tmp_path):
     from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
 
