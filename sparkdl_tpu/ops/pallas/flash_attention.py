@@ -49,17 +49,22 @@ def _make_kernel(bq, bk, seq_len, causal, scale, with_lse=False):
 
     def kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse):
         qi = pl.program_id(2)
-        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+        # Matmul INPUTS stay in the storage dtype (bf16 on TPU): the
+        # MXU takes bf16 natively at full rate, while fp32 operands
+        # run as multi-pass bf16 splits — casting up front would
+        # throttle both matmuls. fp32 happens where it matters: the
+        # accumulators (preferred_element_type) and the softmax state.
+        q = q_ref[0, 0]                                      # (bq, d)
         d = q.shape[-1]
 
         def body(j, carry):
             m, l, acc = carry
-            kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-            vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            kb = k_ref[0, 0, pl.ds(j * bk, bk), :]
+            vb = v_ref[0, 0, pl.ds(j * bk, bk), :]
             s_ij = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )                                                 # (bq, bk)
+            ) * scale                                         # (bq, bk)
             if causal:
                 s_ij = jnp.where(
                     _causal_keep(qi * bq, j * bk, bq, bk), s_ij, NEG_INF
@@ -70,8 +75,10 @@ def _make_kernel(bq, bk, seq_len, causal, scale, with_lse=False):
             p = jnp.where((m_new <= NEG_INF / 2)[:, None], 0.0, p)
             alpha = jnp.exp(m - m_new)
             l_new = l * alpha + jnp.sum(p, axis=-1)
+            # p in [0,1] keeps full relative precision through the
+            # bf16 cast; the accumulation below stays fp32
             pv = jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             acc_new = acc * alpha[:, None] + pv
@@ -155,14 +162,16 @@ def _make_dq_kernel(bq, bk, seq_len, causal, scale):
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
         qi = pl.program_id(2)
-        q = q_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 operands into every matmul (MXU-native rate), fp32
+        # accumulators — see the forward kernel's dtype note.
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]                           # (bq,)
         delta = delta_ref[0, 0, :, 0]                       # (bq,)
 
         def body(j, dq):
-            kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-            vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+            kb = k_ref[0, 0, pl.ds(j * bk, bk), :]
+            vb = v_ref[0, 0, pl.ds(j * bk, bk), :]
             s_ij = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -178,7 +187,7 @@ def _make_dq_kernel(bq, bk, seq_len, causal, scale):
             )
             ds = p * (dp - delta[:, None]) * scale
             return dq + jax.lax.dot_general(
-                ds, kb, (((1,), (0,)), ((), ())),
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
 
@@ -202,13 +211,15 @@ def _make_dkv_kernel(bq, bk, seq_len, causal, scale):
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dk_ref, dv_ref):
         ki = pl.program_id(2)
-        kb = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
-        vb = v_ref[0, 0].astype(jnp.float32)
+        # bf16 operands into every matmul (MXU-native rate), fp32
+        # accumulators — see the forward kernel's dtype note.
+        kb = k_ref[0, 0]                                    # (bk, d)
+        vb = v_ref[0, 0]
 
         def body(i, carry):
             dk, dv = carry
-            qb = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-            dob = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            qb = q_ref[0, 0, pl.ds(i * bq, bq), :]
+            dob = do_ref[0, 0, pl.ds(i * bq, bq), :]
             lse = lse_ref[0, 0, pl.ds(i * bq, bq), 0]
             delta = delta_ref[0, 0, pl.ds(i * bq, bq), 0]
             s_ij = jax.lax.dot_general(
@@ -221,7 +232,7 @@ def _make_dkv_kernel(bq, bk, seq_len, causal, scale):
                     _causal_keep(i * bq, ki * bk, bq, bk), p, 0.0
                 )
             dv = dv + jax.lax.dot_general(
-                p, dob, (((0,), (0,)), ((), ())),
+                p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             dp = jax.lax.dot_general(
@@ -230,7 +241,7 @@ def _make_dkv_kernel(bq, bk, seq_len, causal, scale):
             )
             ds = p * (dp - delta[:, None]) * scale
             return dk + jax.lax.dot_general(
-                ds, qb, (((0,), (0,)), ((), ())),
+                ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ), dv
 
