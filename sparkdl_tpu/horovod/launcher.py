@@ -256,10 +256,8 @@ def _resolve_num_workers(np_arg, placement=None):
     slots = (placement.total_slots if placement is not None
              else available_slots())
     if np_arg == 0:
-        logger.warning(
-            "HorovodRunner(np=0) is deprecated (reference README.md:57-61); "
-            "using all available task slots."
-        )
+        # deprecation warning lives in _launch_gang_once (fires once,
+        # before backend dispatch)
         return slots, "cluster", slots
     if np_arg > slots:
         # np exceeds the cluster TOTAL: fail fast, never wait
@@ -466,6 +464,40 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
     from sparkdl_tpu.horovod.topology import Placement, is_local_host
 
+    if np == 0:
+        # warned HERE, once, whichever backend ends up hosting the gang
+        logger.warning(
+            "HorovodRunner(np=0) is deprecated (reference README.md:"
+            "57-61); using all available task slots."
+        )
+    if per_rank_kwargs is not None and np > 0 and len(per_rank_kwargs) != np:
+        raise ValueError(
+            f"per_rank_kwargs has {len(per_rank_kwargs)} entries for a "
+            f"gang of {np}"
+        )
+
+    # Spark barrier-mode backend when a real Spark cluster is attached
+    # (reference runner_base.py:54-61: "the 2nd spark job started by
+    # HorovodRunner"). Tried BEFORE any local slot resolution: cluster
+    # slots live on the EXECUTORS (reference runner_base.py:44-45), so
+    # probing the driver machine's chips first would wrongly fail any
+    # np that exceeds the driver's own count — a 1-core driver in
+    # front of a 64-slot cluster is normal. per_rank_kwargs opts OUT:
+    # the caller pre-sharded rank-private payloads for a process gang,
+    # and the barrier job would silently drop them (the Spark
+    # partition-resident path ships data per-partition instead).
+    if np >= 0 and per_rank_kwargs is None:
+        try:
+            from sparkdl_tpu.horovod.spark_backend import maybe_launch_on_spark
+        except ImportError:
+            pass
+        else:
+            spark_result = maybe_launch_on_spark(
+                np, main, kwargs, driver_log_verbosity
+            )
+            if spark_result is not None:
+                return spark_result.value
+
     spec_placement = Placement.from_env(os.environ)
     num_workers, mode, total_slots = _resolve_num_workers(np, spec_placement)
     if per_rank_kwargs is not None and len(per_rank_kwargs) != num_workers:
@@ -473,21 +505,6 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             f"per_rank_kwargs has {len(per_rank_kwargs)} entries for a "
             f"gang of {num_workers}"
         )
-
-    # Spark barrier-mode backend when a real Spark cluster is attached
-    # (reference runner_base.py:54-61: "the 2nd spark job started by
-    # HorovodRunner").
-    if mode == "cluster":
-        try:
-            from sparkdl_tpu.horovod.spark_backend import maybe_launch_on_spark
-
-            spark_result = maybe_launch_on_spark(
-                num_workers, main, kwargs, driver_log_verbosity
-            )
-            if spark_result is not None:
-                return spark_result.value
-        except ImportError:
-            pass
 
     # Remote-transport availability is knowable NOW — before the slot
     # claim (which can wait minutes for busy slots) and before any

@@ -189,11 +189,15 @@ def _run_barrier_job(barrier_rdd, num_workers, main, kwargs,
 
 def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
     """Launch the gang as a Spark barrier job; returns None when no
-    active SparkSession exists (caller falls back to the local gang)."""
+    active SparkSession exists (caller falls back to the local gang).
+    ``num_workers == 0`` (deprecated np=0) means all cluster slots —
+    resolved HERE against the cluster, not the driver machine."""
     spark = SparkSession.getActiveSession()
     if spark is None:
         return None
     sc = spark.sparkContext
+    if num_workers == 0:
+        num_workers = int(sc.defaultParallelism)
     _check_slots(sc, num_workers)
     rdd = sc.parallelize(range(num_workers), num_workers).barrier()
     return _run_barrier_job(rdd, num_workers, main, kwargs,

@@ -1,0 +1,270 @@
+"""pyspark TEST DOUBLE (see tests/minispark/README.md).
+
+Only the surface `sparkdl_tpu.horovod.spark_backend` drives. This
+package is importable as ``pyspark`` ONLY when tests put
+``tests/minispark/shim`` on sys.path; it must never be installed.
+"""
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+
+
+# ---------------------------------------------------------------------------
+# Driver-side TCP rendezvous: barrier + allGather for the executor gang.
+# All-or-nothing like Spark's barrier: every task must arrive, then all
+# get the gathered payload back.
+# ---------------------------------------------------------------------------
+
+
+class _Rendezvous:
+    def __init__(self, size):
+        self.size = size
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(size * 4)
+        self.address = "127.0.0.1:%d" % self._srv.getsockname()[1]
+        self._lock = threading.Lock()
+        self._rounds = {}  # round id -> {"data": {rank: x}, "conns": []}
+        self._closed = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn):
+        try:
+            header = _recv_exact(conn, 4)
+            (n,) = struct.unpack("!I", header)
+            req = pickle.loads(_recv_exact(conn, n))
+            round_id, rank, data = req
+            with self._lock:
+                r = self._rounds.setdefault(
+                    round_id, {"data": {}, "conns": []}
+                )
+                r["data"][rank] = data
+                r["conns"].append(conn)
+                if len(r["data"]) == self.size:
+                    gathered = [r["data"][i] for i in range(self.size)]
+                    payload = pickle.dumps(gathered)
+                    for c in r["conns"]:
+                        try:
+                            c.sendall(struct.pack("!I", len(payload)))
+                            c.sendall(payload)
+                            c.close()
+                        except OSError:
+                            pass
+                    del self._rounds[round_id]
+        except (OSError, EOFError, pickle.UnpicklingError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return buf
+
+
+class _TaskInfo:
+    def __init__(self, address):
+        self.address = address
+
+
+class BarrierTaskContext:
+    """Executor-side context: created by the exec bootstrap, never by
+    user code. barrier()/allGather() ride the driver rendezvous."""
+
+    _current = None
+
+    def __init__(self, rank, size, rdv_address, timeout=120.0):
+        self._rank = rank
+        self._size = size
+        self._rdv = rdv_address
+        self._round = 0
+        self._timeout = timeout
+
+    @classmethod
+    def get(cls):
+        if cls._current is None:
+            raise RuntimeError("not inside a barrier task")
+        return cls._current
+
+    def partitionId(self):
+        return self._rank
+
+    def getTaskInfos(self):
+        # all executors are local subprocesses in the double
+        return [_TaskInfo("127.0.0.1:0") for _ in range(self._size)]
+
+    def allGather(self, message=""):
+        self._round += 1
+        host, port = self._rdv.rsplit(":", 1)
+        with socket.create_connection(
+            (host, int(port)), timeout=self._timeout
+        ) as conn:
+            conn.settimeout(self._timeout)
+            payload = pickle.dumps((self._round, self._rank, message))
+            conn.sendall(struct.pack("!I", len(payload)))
+            conn.sendall(payload)
+            (n,) = struct.unpack("!I", _recv_exact(conn, 4))
+            return pickle.loads(_recv_exact(conn, n))
+
+    def barrier(self):
+        self.allGather("")
+
+
+# ---------------------------------------------------------------------------
+# RDD / barrier job execution: one subprocess per partition.
+# ---------------------------------------------------------------------------
+
+
+class Row:
+    def __init__(self, fields):
+        self._fields = dict(fields)
+
+    def asDict(self):
+        return dict(self._fields)
+
+    def __getitem__(self, i):
+        if isinstance(i, int):
+            return list(self._fields.values())[i]
+        return self._fields[i]
+
+    def __eq__(self, other):
+        return isinstance(other, Row) and self._fields == other._fields
+
+    def __hash__(self):
+        return hash(tuple(sorted(
+            (k, _hashable(v)) for k, v in self._fields.items()
+        )))
+
+    def __repr__(self):
+        return "Row(%r)" % (self._fields,)
+
+
+def _hashable(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
+class _BarrierRDD:
+    def __init__(self, partitions):
+        self._partitions = partitions  # list of list-of-Row (or ints)
+
+    def mapPartitions(self, fn):
+        return _BarrierJob(self._partitions, fn)
+
+
+class _BarrierJob:
+    def collect(self):
+        size = len(self._partitions)
+        rdv = _Rendezvous(size)
+        tmp = tempfile.mkdtemp(prefix="minispark-")
+        procs = []
+        try:
+            import cloudpickle
+
+            shim_dir = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            for r, part in enumerate(self._partitions):
+                pay = os.path.join(tmp, "task-%d.pkl" % r)
+                with open(pay, "wb") as f:
+                    cloudpickle.dump((self._fn, list(part)), f)
+                env = dict(os.environ)
+                env["MINISPARK_RANK"] = str(r)
+                env["MINISPARK_SIZE"] = str(size)
+                env["MINISPARK_RDV"] = rdv.address
+                env["MINISPARK_PAYLOAD"] = pay
+                env["MINISPARK_OUT"] = pay + ".out"
+                # executors must resolve `import pyspark` to this shim
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [shim_dir] + env.get("PYTHONPATH", "").split(os.pathsep)
+                ).rstrip(os.pathsep)
+                # the driver's forced virtual-device flags are the
+                # driver's own (mirrors the real launcher's scrub)
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" in flags:
+                    env["XLA_FLAGS"] = " ".join(
+                        t for t in flags.split()
+                        if not t.startswith(
+                            "--xla_force_host_platform_device_count")
+                    )
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "pyspark._exec"],
+                    env=env,
+                    stderr=subprocess.PIPE, text=True,
+                ))
+            outs = []
+            errs = []
+            for r, p in enumerate(procs):
+                _, err = p.communicate(timeout=300)
+                if p.returncode != 0:
+                    errs.append((r, err))
+            if errs:
+                r, err = errs[0]
+                raise RuntimeError(
+                    "minispark task %d failed:\n%s" % (r, err[-4000:])
+                )
+            for r in range(size):
+                out_path = os.path.join(tmp, "task-%d.pkl.out" % r)
+                with open(out_path, "rb") as f:
+                    outs.extend(cloudpickle.load(f))
+            return outs
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            rdv.close()
+
+    def __init__(self, partitions, fn):
+        self._partitions = partitions
+        self._fn = fn
+
+
+class _SparkContext:
+    def __init__(self, n_slots):
+        self.defaultParallelism = n_slots
+
+    def parallelize(self, data, num_partitions):
+        data = list(data)
+        parts = [[] for _ in range(num_partitions)]
+        for i, x in enumerate(data):
+            parts[i % num_partitions].append(x)
+        return _RDD(parts)
+
+
+class _RDD:
+    def __init__(self, partitions):
+        self._partitions = partitions
+
+    def getNumPartitions(self):
+        return len(self._partitions)
+
+    def barrier(self):
+        return _BarrierRDD(self._partitions)

@@ -1,0 +1,38 @@
+"""minispark executor bootstrap: one process per barrier task.
+
+Loads the cloudpickled partition function, installs the
+BarrierTaskContext wired to the driver's rendezvous, runs the
+partition, writes the results where the driver expects them. Mirrors
+(deliberately) how real Spark python workers execute a barrier
+mapPartitions task.
+"""
+
+import os
+import sys
+
+
+def main():
+    import cloudpickle
+
+    from pyspark import BarrierTaskContext
+
+    rank = int(os.environ["MINISPARK_RANK"])
+    size = int(os.environ["MINISPARK_SIZE"])
+    BarrierTaskContext._current = BarrierTaskContext(
+        rank, size, os.environ["MINISPARK_RDV"]
+    )
+    with open(os.environ["MINISPARK_PAYLOAD"], "rb") as f:
+        fn, rows = cloudpickle.load(f)
+    out = list(fn(iter(rows)))
+    with open(os.environ["MINISPARK_OUT"], "wb") as f:
+        cloudpickle.dump(out, f)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()
+        sys.exit(1)

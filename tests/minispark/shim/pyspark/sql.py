@@ -1,0 +1,70 @@
+"""pyspark.sql TEST DOUBLE — see tests/minispark/README.md."""
+
+from pyspark import Row, _RDD, _SparkContext
+
+
+class DataFrame:
+    """Pandas-backed, partitioned. __module__ is 'pyspark.sql', so
+    sparkdl_tpu.ml.dataframe.is_spark_df detects it like the real one."""
+
+    def __init__(self, pdf, n_partitions, columns=None):
+        self._pdf = pdf.reset_index(drop=True)
+        self._n = max(1, int(n_partitions))
+        if columns is not None:
+            self._pdf.columns = list(columns)
+
+    # -- surface the backend drives -----------------------------------
+    @property
+    def rdd(self):
+        rows = [
+            Row(rec) for rec in self._pdf.to_dict(orient="records")
+        ]
+        parts = [[] for _ in range(self._n)]
+        n_rows = len(rows)
+        per = (n_rows + self._n - 1) // self._n if n_rows else 0
+        for i, r in enumerate(rows):
+            parts[min(i // per, self._n - 1) if per else 0].append(r)
+        return _RDD(parts)
+
+    def repartition(self, n):
+        # real repartition shuffles; round-robin is enough for a double
+        return DataFrame(self._pdf, n)
+
+    def select(self, col):
+        return DataFrame(self._pdf[[col]].copy(), self._n)
+
+    def distinct(self):
+        return DataFrame(self._pdf.drop_duplicates(), self._n)
+
+    def collect(self):
+        return [Row(rec) for rec in self._pdf.to_dict(orient="records")]
+
+    def toPandas(self):
+        return self._pdf.copy()
+
+
+class SparkSession:
+    _active = None
+
+    def __init__(self, n_slots=2):
+        self.sparkContext = _SparkContext(n_slots)
+
+    @classmethod
+    def getActiveSession(cls):
+        return cls._active
+
+    # test helper (the real builder API is out of scope for the double)
+    @classmethod
+    def _activate(cls, n_slots=2):
+        cls._active = cls(n_slots)
+        return cls._active
+
+    @classmethod
+    def _deactivate(cls):
+        cls._active = None
+
+    def createDataFrame(self, rows, columns):
+        import pandas as pd
+
+        pdf = pd.DataFrame(list(rows), columns=list(columns))
+        return DataFrame(pdf, self.sparkContext.defaultParallelism)
