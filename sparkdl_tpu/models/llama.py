@@ -175,17 +175,36 @@ class Attention(nn.Module):
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32),
             )
-            start = cidx.value
-            pos_dec = start + jnp.arange(s)
-            q = apply_rope(q, cos, sin, pos_dec)
-            k = apply_rope(k, cos, sin, pos_dec)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, start, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, start, 0, 0)
-            )
-            cidx.value = start + s
+            if positions is not None:
+                # Slot-mapped serving (continuous batching): every
+                # batch row is an independent stream at its OWN
+                # position — the caller owns the per-slot position
+                # vector; the shared cache index is not advanced.
+                pos_dec = jnp.asarray(positions, jnp.int32)
+                if pos_dec.ndim == 1:
+                    pos_dec = jnp.broadcast_to(pos_dec[None], (b, s))
+                q = apply_rope(q, cos, sin, pos_dec)
+                k = apply_rope(k, cos, sin, pos_dec)
+                bidx = jnp.arange(b)[:, None]
+                ck.value = ck.value.at[bidx, pos_dec].set(k)
+                cv.value = cv.value.at[bidx, pos_dec].set(v)
+                mask = (jnp.arange(cfg.max_cache_len)[None, None, :]
+                        <= pos_dec[:, :, None])      # (b, s, L)
+                mask = mask[:, None]                 # (b, 1, s, L)
+            else:
+                start = cidx.value
+                pos_dec = start + jnp.arange(s)
+                q = apply_rope(q, cos, sin, pos_dec)
+                k = apply_rope(k, cos, sin, pos_dec)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, start, 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, start, 0, 0)
+                )
+                cidx.value = start + s
+                k_pos = jnp.arange(cfg.max_cache_len)
+                mask = (k_pos[None, :] <= pos_dec[:, None])[None, None]
             k, v = ck.value, cv.value
             rep = cfg.n_heads // cfg.n_kv_heads
             if rep > 1:
@@ -197,9 +216,7 @@ class Attention(nn.Module):
             scores = jnp.einsum(
                 "bqhd,bkhd->bhqk", q32, k.astype(jnp.float32)
             ) * (head_dim ** -0.5)
-            k_pos = jnp.arange(cfg.max_cache_len)
-            mask = k_pos[None, :] <= pos_dec[:, None]
-            scores = jnp.where(mask[None, None], scores, -1e30)
+            scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum(
                 "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
@@ -289,8 +306,12 @@ class Llama(nn.Module):
         the default so the param tree always contains ``lm_head``."""
         cfg = self.cfg
         b, s = tokens.shape
-        if positions is None:
+        if positions is None and not cfg.decode:
             positions = jnp.arange(s)
+        # cfg.decode keeps a None default: the attention cache index is
+        # the position source of truth there, and an EXPLICIT positions
+        # array (slot-mapped continuous-batching serving) must be
+        # distinguishable from the default.
         head_dim = cfg.d_model // cfg.n_heads
         # Static RoPE table covering both training (seq s) and cached
         # decoding (positions < max_cache_len).

@@ -1,0 +1,275 @@
+"""Continuous-batching decode engine: slot-mapped KV cache, per-slot
+positions, admission of new sequences between decode chunks.
+
+Single-stream serving (models/generate.py) leaves the chip idle
+whenever one sequence finishes before another would start; production
+serving interleaves many requests through a fixed set of batch SLOTS
+(vLLM-style iteration-level scheduling, re-thought for XLA):
+
+- The KV cache is one batched pytree with leading dim = n_slots; slot
+  ``i``'s rows belong to whichever request currently occupies it.
+- Every decode step runs ONE jitted program over all slots with an
+  explicit per-slot position vector (``positions`` in the model's
+  decode path — the slot-mapped branch in ``models/llama.py``).
+- Python-level scheduling happens only every ``chunk`` tokens: the
+  decode loop is a ``lax.scan`` (per-token host dispatch would pay a
+  ~25 ms tunnel round trip per token), so admission granularity is the
+  chunk, a deliberate XLA-first trade-off against per-iteration
+  admission.
+- Admission: a finished slot is refilled by PREFILLING the queued
+  request's prompt (bucket-padded to bound recompiles; the sampled
+  first token is taken at the true prompt end) and inserting its cache
+  rows, position, and first token into the batched state.
+
+Inactive slots keep decoding junk into their frozen position — one
+overwritten, never-visible cache row — which costs nothing extra on
+the MXU (the batch dim is fixed) and keeps every program shape static.
+
+No reference counterpart (the reference is a training-launcher stub);
+this is the serving-depth side of SURVEY.md §2's model-zoo story.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket")
+
+
+@functools.lru_cache(maxsize=64)
+def _engine_programs(dec_cfg, temperature):
+    """(prefill, insert, decode_chunk) jitted once per (decode config,
+    temperature) — module-level like generate._decode_programs, so a
+    fresh engine instance reuses compiled programs instead of paying
+    XLA again (an engine per request burst is the normal usage)."""
+    from sparkdl_tpu.models.llama import Llama
+
+    model = Llama(dec_cfg)
+
+    def _sample(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def prefill(params, padded_prompt, rng, true_len):
+        # standard shared-index decode-mode prefill, batch 1; junk pad
+        # rows land at positions >= true_len where the causal cache
+        # mask keeps them invisible until overwritten. true_len is a
+        # TRACED scalar: one compile per bucket, not per prompt length.
+        logits, state = model.apply(
+            {"params": params}, padded_prompt, mutable=["cache"],
+        )
+        last = logits[:, true_len - 1]
+        return state["cache"], _sample(last, rng)
+
+    @jax.jit
+    def insert(cache, pos, token, one_cache, new_token, p_len, slot):
+        # scalar leaves (the shared cache_index, unused on the
+        # slot-mapped path) pass through; K/V rows land in the slot
+        cache = jax.tree.map(
+            lambda full, one: (
+                full if full.ndim == 0 else full.at[slot].set(one[0])
+            ),
+            cache, one_cache,
+        )
+        return (cache, pos.at[slot].set(p_len),
+                token.at[slot].set(new_token[0]))
+
+    @functools.partial(jax.jit, static_argnums=(6,),
+                       donate_argnums=(1,))
+    def decode_chunk(params, cache, token, pos, active, rng, n):
+        def body(carry, _):
+            cache, token, pos, rng = carry
+            logits, st = model.apply(
+                {"params": params, "cache": cache},
+                token[:, None], positions=pos[:, None],
+                mutable=["cache"],
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub)
+            # inactive slots freeze: position pinned (their junk
+            # write is overwritten in place, never visible)
+            pos = jnp.where(active, pos + 1, pos)
+            return (st["cache"], nxt, pos, rng), nxt
+
+        (cache, token, pos, rng), toks = jax.lax.scan(
+            body, (cache, token, pos, rng), None, length=n
+        )
+        return cache, token, pos, rng, toks  # toks: (n, n_slots)
+
+    return prefill, insert, decode_chunk
+
+
+@dataclasses.dataclass
+class _Slot:
+    req_id: int = -1
+    active: bool = False
+    remaining: int = 0
+    tokens: list = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatchingEngine:
+    """Greedy/temperature decoding over ``n_slots`` concurrent streams.
+
+    Usage::
+
+        eng = ContinuousBatchingEngine(model, params, n_slots=4)
+        rid = eng.submit(prompt_tokens_1d, max_new_tokens=64)
+        results = eng.run()          # {rid: np.ndarray of new tokens}
+
+    ``stats`` afterwards holds steps, slot-step counts, and the slot
+    utilization ratio (active slot-steps / total slot-steps).
+    """
+
+    def __init__(self, model, params, *, n_slots=4, temperature=0.0,
+                 eos_id=None, chunk=16, rng=None):
+        cfg = model.cfg
+        self.cfg = dataclasses.replace(cfg, decode=True)
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.chunk = int(chunk)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        from sparkdl_tpu.models.llama import Llama
+
+        self._model = Llama(self.cfg)
+        self._queue = []          # (req_id, prompt np.ndarray, max_new)
+        self._slots = [_Slot() for _ in range(self.n_slots)]
+        self._results = {}
+        self._next_id = 0
+        self.stats = {"steps": 0, "active_slot_steps": 0,
+                      "total_slot_steps": 0}
+
+        # Device state: batched cache, per-slot position, last token.
+        dummy = jnp.zeros((self.n_slots, 1), jnp.int32)
+        state = self._model.init(jax.random.PRNGKey(0), dummy,
+                                 positions=jnp.zeros((self.n_slots, 1),
+                                                     jnp.int32))
+        self._cache = state["cache"]
+        self._pos = jnp.zeros((self.n_slots,), jnp.int32)
+        self._token = jnp.zeros((self.n_slots,), jnp.int32)
+
+    # -- public API ---------------------------------------------------
+
+    @property
+    def _programs(self):
+        return _engine_programs(self.cfg, self.temperature)
+
+    @property
+    def _prefill_fn(self):
+        return self._programs[0]
+
+    @property
+    def _insert_fn(self):
+        return self._programs[1]
+
+    @property
+    def _decode_chunk_fn(self):
+        return self._programs[2]
+
+    def submit(self, prompt_tokens, max_new_tokens):
+        """Queue a request; returns its id."""
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if len(prompt) + max_new_tokens > self.cfg.max_cache_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_cache_len "
+                f"({self.cfg.max_cache_len})"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, int(max_new_tokens)))
+        return rid
+
+    def _admit(self, slot_idx):
+        rid, prompt, max_new = self._queue.pop(0)
+        p_len = len(prompt)
+        bucket = min(_bucket(p_len), self.cfg.max_cache_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p_len] = prompt
+        self._rng, sub = jax.random.split(self._rng)
+        one_cache, tok = self._prefill_fn(
+            self.params, jnp.asarray(padded), sub, p_len
+        )
+        self._cache, self._pos, self._token = self._insert_fn(
+            self._cache, self._pos, self._token, one_cache, tok,
+            p_len, slot_idx,
+        )
+        s = self._slots[slot_idx]
+        s.req_id, s.active = rid, True
+        s.remaining = max_new - 1  # the prefill emitted token #1
+        s.tokens = [int(np.asarray(tok)[0])]
+        if (self.eos_id is not None and s.tokens[0] == self.eos_id) \
+                or s.remaining == 0:
+            self._finish(slot_idx)
+
+    def _finish(self, slot_idx):
+        s = self._slots[slot_idx]
+        self._results[s.req_id] = np.asarray(s.tokens, np.int32)
+        s.active = False
+        s.tokens = []
+
+    def run(self, progress=None):
+        """Drain the queue; returns {req_id: generated tokens}."""
+        while self._queue or any(s.active for s in self._slots):
+            # fill free slots from the queue
+            for i, s in enumerate(self._slots):
+                if not s.active and self._queue:
+                    self._admit(i)
+            active = np.array([s.active for s in self._slots])
+            if not active.any():
+                continue
+            # Chunk length: sized to the soonest-finishing active slot
+            # (so its replacement isn't kept waiting), then rounded UP
+            # to a power of two — the scan program compiles O(log
+            # chunk) times total instead of once per distinct tail
+            # length. Overshoot is discarded host-side (same as
+            # mid-chunk eos). Cache capacity can never bind: submit()
+            # guarantees p_len + max_new <= max_cache_len per slot.
+            need = min(s.remaining for s in self._slots if s.active)
+            n = 1
+            while n < need and n < self.chunk:
+                n *= 2
+            n = min(n, self.chunk)
+            (self._cache, self._token, self._pos, self._rng,
+             toks) = self._decode_chunk_fn(
+                self.params, self._cache, self._token, self._pos,
+                jnp.asarray(active), self._rng, n,
+            )
+            toks = np.asarray(toks)                 # (n, n_slots)
+            self.stats["steps"] += n
+            self.stats["total_slot_steps"] += n * self.n_slots
+            self.stats["active_slot_steps"] += int(active.sum()) * n
+            for i, s in enumerate(self._slots):
+                if not s.active:
+                    continue
+                for t in toks[:, i]:
+                    s.tokens.append(int(t))
+                    s.remaining -= 1
+                    if ((self.eos_id is not None and int(t) == self.eos_id)
+                            or s.remaining == 0):
+                        self._finish(i)
+                        break
+            if progress is not None:
+                progress(self)
+        self.stats["utilization"] = (
+            self.stats["active_slot_steps"]
+            / max(1, self.stats["total_slot_steps"])
+        )
+        return dict(self._results)
