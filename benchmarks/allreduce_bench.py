@@ -1,22 +1,41 @@
 #!/usr/bin/env python
-"""hvd.allreduce bandwidth benchmark (the BASELINE.json secondary
+"""hvd collective bandwidth benchmark (the BASELINE.json secondary
 metric: "hvd.allreduce vs lax.psum bandwidth").
 
-Runs a HorovodRunner gang (np from argv, default -2) and measures the
-shim's end-to-end allreduce bandwidth — tensor in, reduced tensor out,
-including the host<->device crossings — against the raw in-jit
-``lax.psum`` the shim lowers to. On a pod the gap is the shim's
-host-bridge overhead; JAX-native mains avoid it entirely by staying
-under jit.
+Two sections:
 
-Usage: python benchmarks/allreduce_bench.py [np] (e.g. -4)
+- gang (default): a HorovodRunner gang (np from argv, default -2)
+  measures the shim's end-to-end collective bandwidth — tensor in,
+  reduced tensor out, including the host<->device crossings — for
+  allreduce, reducescatter (must move ~1/n the bytes of allreduce),
+  and broadcast, against the raw in-jit ``lax.psum`` the shim lowers
+  to. On a pod the gap is the shim's host-bridge overhead; JAX-native
+  mains avoid it entirely by staying under jit.
+- ``--tpu``: IN-PROCESS on the accelerator (this host has ONE chip, so
+  size=1 makes the collective semantics identity — what this measures
+  honestly is the real per-call cost of each path ON TPU: the
+  numpy-in/numpy-out shim, the device-resident ``reduce_jax`` fast
+  path, and the raw H2D/D2H bridge each collective call otherwise
+  pays). Multi-chip ICI numbers still require a pod.
+
+Usage: python benchmarks/allreduce_bench.py [np]      (gang section)
+       python benchmarks/allreduce_bench.py --tpu     (on-chip section)
 """
 
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, reps=10):
+    fn()  # warm (compile/caches)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
 
 
 def bench_main(sizes_mb):
@@ -53,30 +72,31 @@ def bench_main(sizes_mb):
         return round(2 * (hvd.size() - 1) / hvd.size() * mb / 1024 / dt, 3)
 
     results = []
+    reps = 5
     for mb in sizes_mb:
         n = int(mb * (1 << 20) / 4)
+        # dim0 divisible by size for reducescatter
+        n -= n % hvd.size()
         x = np.ones((n,), np.float32)
-        hvd.allreduce(x)  # warm (compile)
-        reps = 5
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            hvd.allreduce(x)
-        dt = (time.perf_counter() - t0) / reps
+        dt = _timeit(lambda: hvd.allreduce(x), reps)
+        # reducescatter returns only this rank's 1/n chunk — one
+        # psum_scatter, ~1/n the interconnect bytes of allreduce
+        dt_rs = _timeit(lambda: hvd.reducescatter(x, op=hvd.Sum), reps)
+        dt_bc = _timeit(lambda: hvd.broadcast(x, root_rank=0), reps)
 
         local = jax.device_put(x[None], by_proc[jax.process_index()])
         xg = jax.make_array_from_single_device_arrays(
             (hvd.size(),) + x.shape, NamedSharding(mesh, P("hvd")), [local]
         )
-        psum(xg).block_until_ready()  # warm
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            psum(xg).block_until_ready()
-        dt_jit = (time.perf_counter() - t0) / reps
+        dt_jit = _timeit(lambda: psum(xg).block_until_ready(), reps)
 
         results.append({
             "size_mb": mb,
             "shim_time_ms": round(dt * 1e3, 3),
             "shim_busbw_gbps": busbw(mb, dt),
+            "reducescatter_time_ms": round(dt_rs * 1e3, 3),
+            "reducescatter_vs_allreduce": round(dt_rs / dt, 3),
+            "broadcast_time_ms": round(dt_bc * 1e3, 3),
             "injit_time_ms": round(dt_jit * 1e3, 3),
             "injit_busbw_gbps": busbw(mb, dt_jit),
             "host_bridge_overhead_ms": round((dt - dt_jit) * 1e3, 3),
@@ -84,7 +104,74 @@ def bench_main(sizes_mb):
     return {"size": hvd.size(), "results": results} if hvd.rank() == 0 else None
 
 
+def tpu_section(sizes_mb):
+    """In-process, on the accelerator (single chip => size=1 identity
+    semantics; measures each path's real per-call cost on TPU)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+
+    hvd.init()
+    dev = jax.devices()[0]
+    results = []
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        x = np.ones((n,), np.float32)
+        xd = jax.device_put(jnp.ones((n,), jnp.float32), dev)
+        xd.block_until_ready()
+
+        t_shim = _timeit(lambda: hvd.allreduce(x))
+        # device-resident fast path (jax.Array in, jax.Array out)
+        t_dev = _timeit(lambda: jax.block_until_ready(hvd.allreduce(xd)))
+        t_rs = _timeit(lambda: hvd.reducescatter(x, op=hvd.Sum))
+        t_bc = _timeit(lambda: hvd.broadcast(x, root_rank=0))
+        # raw bridge each numpy-path call pays: H2D upload + D2H read.
+        # D2H needs a FRESH device array per rep — jax.Array caches its
+        # numpy value after the first conversion, so re-reading one
+        # array times a host memcpy of the cache, not the transfer.
+        t_h2d = _timeit(
+            lambda: jax.device_put(x, dev).block_until_ready())
+        reps = 10
+        fresh = [jax.device_put(xd + i, dev) for i in range(reps + 1)]
+        jax.block_until_ready(fresh)
+        np.asarray(fresh[-1])  # warm the conversion path itself
+        t0 = time.perf_counter()
+        for i in range(reps):
+            np.asarray(fresh[i])
+        t_d2h = (time.perf_counter() - t0) / reps
+
+        results.append({
+            "size_mb": mb,
+            "allreduce_numpy_ms": round(t_shim * 1e3, 3),
+            "allreduce_device_resident_ms": round(t_dev * 1e3, 3),
+            "reducescatter_numpy_ms": round(t_rs * 1e3, 3),
+            "broadcast_numpy_ms": round(t_bc * 1e3, 3),
+            "h2d_ms": round(t_h2d * 1e3, 3),
+            "d2h_ms": round(t_d2h * 1e3, 3),
+            "bridge_total_ms": round((t_h2d + t_d2h) * 1e3, 3),
+        })
+    return {
+        "platform": dev.platform,
+        "size": hvd.size(),
+        "note": ("single chip: collective semantics are identity; "
+                 "numbers are per-call path costs (dispatch + bridge), "
+                 "not interconnect bandwidth"),
+        "results": results,
+    }
+
+
 def main():
+    plat = os.environ.get("SPARKDL_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    if "--tpu" in sys.argv:
+        out = tpu_section(sizes_mb=[1, 8, 64])
+        print(json.dumps({"benchmark": "hvd_collectives_on_tpu", **out}))
+        return
     np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -2
     from sparkdl import HorovodRunner
 

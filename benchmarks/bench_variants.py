@@ -106,19 +106,33 @@ def main():
     if os.environ.get("SPARKDL_TPU_VARIANTS_FULL"):
         variants += [
             {"attention": "flash", "batch": 8, "seq": 1024},
+            {"attention": "flash", "batch": 8, "seq": 1024,
+             "flash_block": 256},
+            {"attention": "flash", "batch": 8, "seq": 1024,
+             "flash_block": 512},
             {"attention": "flash", "batch": 16, "seq": 1024},
+            {"attention": "flash", "batch": 16, "seq": 1024,
+             "flash_block": 256},
             {"attention": "flash", "batch": 4, "seq": 4096,
              "remat": True},
+            {"attention": "flash", "batch": 4, "seq": 4096,
+             "remat": True, "flash_block": 256},
             {"attention": "reference", "batch": 4, "seq": 4096,
              "remat": True},
         ]
     for v in variants:
+        block = v.pop("flash_block", None)
+        if block is not None:
+            os.environ["SPARKDL_TPU_FLASH_BLOCK"] = str(block)
+        else:
+            os.environ.pop("SPARKDL_TPU_FLASH_BLOCK", None)
+        label = dict(v, **({"flash_block": block} if block else {}))
         try:
             tps = measure(**v)
-            print(json.dumps({**v, "tokens_per_sec": round(tps, 1)}),
+            print(json.dumps({**label, "tokens_per_sec": round(tps, 1)}),
                   flush=True)
         except Exception as e:  # keep sweeping on OOM etc.
-            print(json.dumps({**v, "error": str(e)[:200]}), flush=True)
+            print(json.dumps({**label, "error": str(e)[:200]}), flush=True)
 
 
 if __name__ == "__main__":

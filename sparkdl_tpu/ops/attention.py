@@ -78,7 +78,14 @@ def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     s = qt.shape[2]
-    block = block_for(s)
+    # SPARKDL_TPU_FLASH_BLOCK: bench_variants' flash tile sweep (larger
+    # q/k tiles amortize K/V streaming and widen the per-program
+    # matmuls at short seq). Scoped HERE so the knob cannot retune
+    # unrelated pallas kernels that share block_for.
+    import os
+
+    tile = int(os.environ.get("SPARKDL_TPU_FLASH_BLOCK", 128))
+    block = block_for(s, tile=tile)
     qt, pad = _pad_to(qt, block, 2)
     if pad and not causal:
         # padded keys must not receive attention weight: causal masking
