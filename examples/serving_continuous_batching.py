@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Continuous-batching serving: many generation requests with
+different prompt lengths and budgets interleaved through a fixed set
+of KV-cache slots (models/serving.py). Run with no args for a small
+CPU-friendly config; on a TPU host drop the --tiny default for the
+serving-size model.
+
+The engine keeps the chip busy: when one stream finishes, the next
+queued request is prefilled into the freed slot mid-run — aggregate
+throughput scales with slot utilization instead of being serialized
+per request (see slot_utilization in the printed stats).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main(tiny=True):
+    import jax
+    import jax.numpy as jnp
+
+    if tiny:
+        jax.config.update("jax_platforms", "cpu")
+    from sparkdl_tpu.models import Llama, LlamaConfig
+    from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+
+    if tiny:
+        cfg = LlamaConfig.tiny(max_cache_len=128)
+        n_slots, chunk = 2, 8
+        reqs = [(12, 24), (8, 40), (16, 16), (10, 32)]
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16,
+            max_cache_len=2048,
+        )
+        n_slots, chunk = 8, 32
+        reqs = [(64 + 16 * (i % 5), 128 + 64 * (i % 4))
+                for i in range(24)]
+
+    model = Llama(cfg)
+    gen = np.random.default_rng(0)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    eng = ContinuousBatchingEngine(model, params, n_slots=n_slots,
+                                   chunk=chunk)
+    rids = [
+        eng.submit(gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32),
+                   budget)
+        for p, budget in reqs
+    ]
+    results = eng.run()
+    for rid in rids:
+        print(f"request {rid}: {len(results[rid])} tokens "
+              f"-> {results[rid][:8].tolist()}...")
+    print(f"stats: {eng.stats}")
+
+
+if __name__ == "__main__":
+    main(tiny="--full" not in sys.argv)
