@@ -37,6 +37,24 @@ def _gang_main():
     return {"size": hvd.size(), "sum": total.tolist()}
 
 
+def _gang_main_bcast():
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+
+    hvd.init()
+    # tree-ppermute broadcast: only meaningful at 3+ ranks (a 2-rank
+    # gang can't catch duplicate-source bugs — round-3 learning)
+    b = hvd.broadcast(np.array([hvd.rank() * 10.0], np.float32),
+                      root_rank=1)
+    # RAGGED allgather: rank r contributes r+1 rows, exercising the
+    # size-exchange + pad + trim path
+    gathered = hvd.allgather(
+        np.full((hvd.rank() + 1, 1), hvd.rank(), np.int32))
+    return {"size": hvd.size(), "bcast": b.tolist(),
+            "gathered": gathered.tolist()}
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -167,3 +185,27 @@ def test_remote_transport_fake_ssh(monkeypatch, tmp_path):
     assert result["sum"] == [2.0, 2.0]
     hosts = set(contacted.read_text().split())
     assert hosts == {"fakeremote-a.invalid", "fakeremote-b.invalid"}
+
+
+@pytest.mark.gang
+def test_remote_transport_three_ranks_tree_broadcast(monkeypatch,
+                                                     tmp_path):
+    """3 ranks across 3 'remote' hosts: the tree-ppermute broadcast
+    and ragged allgather run through the transport (2 ranks cannot
+    exercise the broadcast tree's multi-round structure)."""
+    fake = tmp_path / "fakessh"
+    fake.write_text('#!/bin/sh\nshift\nexec sh -c "$*"\n')
+    fake.chmod(0o755)
+    monkeypatch.setenv(
+        "SPARKDL_TPU_HOSTS",
+        "fr-a.invalid:1,fr-b.invalid:1,fr-c.invalid:1")
+    monkeypatch.setenv("SPARKDL_TPU_REMOTE_SHELL", str(fake))
+    monkeypatch.setenv("SPARKDL_TPU_REMOTE_PYTHON", sys.executable)
+    monkeypatch.setenv("SPARKDL_TPU_COORDINATOR",
+                       f"127.0.0.1:{_free_port()}")
+
+    result = HorovodRunner(np=3).run(_gang_main_bcast)
+    assert result["size"] == 3
+    assert result["bcast"] == [10.0]  # root_rank=1's value, everywhere
+    # ragged concat along dim0: 1 row from rank 0, 2 from 1, 3 from 2
+    assert result["gathered"] == [[0], [1], [1], [2], [2], [2]]

@@ -98,6 +98,30 @@ def test_engine_eos_frees_slot_early(setup):
     assert n == 4 or int(results[r2][-1]) == eos
 
 
+def test_engine_with_int8_weights(setup):
+    """The slot-mapped decode branch composes with int8 weight-only
+    serving: engine tokens must match single-stream generate() run on
+    the SAME quantized tree (int8 vs bf16 trees diverge, so the oracle
+    must be quantized too)."""
+    import dataclasses
+
+    from sparkdl_tpu.models.quant import quantize_llama_params
+
+    cfg, model, params = setup
+    q_tree = quantize_llama_params(params)
+    cfg_q = dataclasses.replace(cfg, quant="int8")
+    model_q = Llama(cfg_q)
+
+    rng = np.random.default_rng(4)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(model_q, q_tree, n_slots=2, chunk=4)
+    rid = eng.submit(p, 7)
+    results = eng.run()
+    np.testing.assert_array_equal(
+        results[rid], _oracle(model_q, q_tree, p, 7)
+    )
+
+
 def test_engine_rejects_oversized_request(setup):
     cfg, model, params = setup
     eng = ContinuousBatchingEngine(model, params, n_slots=1)
