@@ -133,10 +133,15 @@ class ContinuousBatchingEngine:
     """
 
     def __init__(self, model, params, *, n_slots=4, temperature=0.0,
-                 eos_id=None, chunk=16, rng=None):
+                 eos_id=None, chunk=16, rng=None, mesh=None,
+                 rules=None):
+        """``mesh`` enables tensor-parallel serving: params are placed
+        per ``rules`` (default TRANSFORMER_RULES — Megatron column/row
+        splits) and the KV cache is sharded over its kv-heads axis on
+        the ``model`` mesh axis; GSPMD inserts the collectives in the
+        same jitted programs the single-device engine runs."""
         cfg = model.cfg
         self.cfg = dataclasses.replace(cfg, decode=True)
-        self.params = params
         self.n_slots = int(n_slots)
         self.temperature = float(temperature)
         self.eos_id = eos_id
@@ -160,6 +165,47 @@ class ContinuousBatchingEngine:
         self._cache = state["cache"]
         self._pos = jnp.zeros((self.n_slots,), jnp.int32)
         self._token = jnp.zeros((self.n_slots,), jnp.int32)
+        self.mesh = mesh
+        self.params = params
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from sparkdl_tpu.parallel.sharding import (
+                TRANSFORMER_RULES,
+                param_sharding,
+            )
+
+            axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            missing = {"model", "fsdp"} - set(axis_sizes)
+            if missing:
+                raise ValueError(
+                    f"TP serving needs mesh axes 'model' and 'fsdp' "
+                    f"(missing {sorted(missing)}); build the mesh with "
+                    "sparkdl_tpu.parallel.mesh.make_mesh"
+                )
+            self.params = jax.device_put(
+                params,
+                param_sharding(
+                    params,
+                    rules if rules is not None else TRANSFORMER_RULES,
+                    mesh,
+                ),
+            )
+            model_size = axis_sizes["model"]
+
+            def cache_spec(leaf):
+                # (n_slots, max_len, kv_heads, head_dim): kv heads ride
+                # the TP axis alongside the head-sharded projections
+                if leaf.ndim == 4 and leaf.shape[2] % model_size == 0:
+                    return NamedSharding(mesh, P(None, None, "model"))
+                return NamedSharding(mesh, P())
+
+            self._cache = jax.device_put(
+                self._cache, jax.tree.map(cache_spec, self._cache))
+            rep = NamedSharding(mesh, P())
+            self._pos = jax.device_put(self._pos, rep)
+            self._token = jax.device_put(self._token, rep)
+            self._rng = jax.device_put(self._rng, rep)
 
     # -- public API ---------------------------------------------------
 
