@@ -22,6 +22,7 @@ from sparkdl_tpu.hvd import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    allgather_object,
     broadcast_object,
     cross_rank,
     cross_size,

@@ -37,6 +37,7 @@ from sparkdl_tpu.hvd import (  # noqa: F401
     allreduce,
     barrier,
     broadcast,
+    allgather_object,
     broadcast_object,
     init,
     is_initialized,
@@ -282,7 +283,7 @@ from horovod.keras.callbacks import (  # noqa: E402,F401
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "allreduce", "allgather", "broadcast",
-    "broadcast_object", "barrier", "DistributedOptimizer",
+    "allgather_object", "broadcast_object", "barrier", "DistributedOptimizer",
     "broadcast_variables", "broadcast_model_variables",
     "BroadcastGlobalVariablesCallback", "LogCallback",
     "init_distribution", "callbacks", "Average", "Sum", "Min", "Max",

@@ -20,6 +20,7 @@ from sparkdl_tpu.hvd import (  # noqa: F401
     allgather,
     alltoall,
     barrier,
+    allgather_object,
     broadcast_object,
     cross_rank,
     cross_size,
@@ -166,7 +167,8 @@ class DistributedGradientTape:
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "allreduce",
-    "grouped_allreduce", "allgather", "broadcast", "broadcast_object",
+    "grouped_allreduce", "allgather", "allgather_object", "broadcast",
+    "broadcast_object",
     "broadcast_variables", "barrier", "alltoall", "Average", "Sum",
     "Min", "Max", "Compression", "DistributedGradientTape",
 ]

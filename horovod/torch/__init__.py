@@ -26,6 +26,7 @@ from sparkdl_tpu.hvd import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    allgather_object,
     broadcast_object,
     cross_rank,
     cross_size,
@@ -265,7 +266,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "allreduce", "allreduce_",
-    "allgather", "broadcast", "broadcast_", "broadcast_object",
+    "allgather", "allgather_object", "broadcast", "broadcast_",
+    "broadcast_object",
     "broadcast_parameters", "broadcast_optimizer_state", "barrier",
     "alltoall", "DistributedOptimizer", "Average", "Sum", "Min", "Max",
     "Compression",

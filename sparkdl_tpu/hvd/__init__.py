@@ -204,6 +204,24 @@ def broadcast_object(obj, root_rank=0, name=None):
     return pickle.loads(payload.tobytes())
 
 
+def allgather_object(obj, name=None):
+    """Pickle-based object allgather (horovod.allgather_object parity):
+    returns ``[rank 0's obj, rank 1's obj, ...]``. Rides the ragged
+    allgather — per-rank payload sizes may differ."""
+    del name
+    _state.require_initialized()
+    if size() == 1:
+        return [obj]
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    sizes = engine().allgather(np.array([[payload.shape[0]]], np.int64))
+    flat = engine().allgather(payload)
+    out, off = [], 0
+    for n in sizes[:, 0]:
+        out.append(pickle.loads(flat[off:off + int(n)].tobytes()))
+        off += int(n)
+    return out
+
+
 def barrier():
     _state.require_initialized()
     engine().barrier()
@@ -406,7 +424,8 @@ class Compression:
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "allreduce",
-    "grouped_allreduce", "allgather", "broadcast", "broadcast_object",
+    "grouped_allreduce", "allgather", "allgather_object", "broadcast",
+    "broadcast_object",
     "barrier", "alltoall", "reducescatter", "Average", "Sum", "Min",
     "Max", "Compression", "mpi_threads_supported", "mpi_built",
     "mpi_enabled", "nccl_built", "gloo_built", "cuda_built", "rocm_built",

@@ -33,10 +33,14 @@ def _allreduce_main(scale):
     # reducescatter: dim0 = size*2; each rank keeps its reduced chunk
     rs_in = np.arange(hvd.size() * 2, dtype=np.float32) + hvd.rank()
     rs = hvd.reducescatter(rs_in, op=hvd.Sum)
+    # allgather_object: ragged pickled payloads, rank order preserved
+    objs = hvd.allgather_object({"r": hvd.rank(),
+                                 "pad": "x" * (hvd.rank() + 1) * 7})
     from sparkdl_tpu.horovod import log_to_driver
 
     log_to_driver(f"rank {hvd.rank()} done")
     return {
+        "objs": [o["r"] for o in objs],
         "rank": hvd.rank(),
         "size": hvd.size(),
         "sum": total.tolist(),
@@ -59,6 +63,7 @@ def test_np_minus_two_gang(capfd):
     assert result["sum"] == [3.0, 3.0, 3.0]
     assert result["avg"] == [1.5, 1.5, 1.5]
     assert result["gathered"] == [[0], [1]]
+    assert result["objs"] == [0, 1]  # allgather_object, rank order
     assert result["bcast"] == [7.0]  # root_rank=1 contributed 1*7
     assert result["scalar_shapes"] == [(), ()]  # 0-d stays 0-d
     assert result["scalar_bcast"] == 3  # rank 0's value

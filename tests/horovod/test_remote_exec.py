@@ -79,10 +79,14 @@ class TestIsLocalHost:
 class TestRemoteCommand:
     def test_forwards_env_delta_and_stdin_payload(self):
         base = {"HOME": "/root", "PYTHONPATH": "/repo:/site",
-                "UNTOUCHED": "x"}
+                "UNTOUCHED": "x",
+                # operator-exported slice layout: equals the computed
+                # value, must STILL cross (the delta rule alone drops it)
+                "TPU_PROCESS_BOUNDS": "2,2,1"}
         env = dict(base)
         env["SPARKDL_TPU_RANK"] = "3"
         env["SPARKDL_TPU_PAYLOAD"] = "/tmp/job/payload-3.pkl"
+        env["TPU_VISIBLE_DEVICES"] = "1"
         cmd = _remote_worker_cmd(
             ["ssh", "-o", "BatchMode=yes"], "hostB", env, base, "python3"
         )
@@ -98,6 +102,10 @@ class TestRemoteCommand:
         assert any(p.startswith("PYTHONPATH=") for p in pairs)
         assert not any(p.startswith("UNTOUCHED=") for p in pairs)
         assert not any(p.startswith("HOME=") for p in pairs)
+        # the whole gang-config namespace crosses, including values
+        # EQUAL to the driver's env (operator-exported TPU layout)
+        assert "TPU_PROCESS_BOUNDS=2,2,1" in pairs
+        assert "TPU_VISIBLE_DEVICES=1" in pairs
 
     def test_secret_never_on_the_command_line(self):
         """argv is world-readable in /proc on both machines while the
