@@ -1,0 +1,121 @@
+"""Speculative decoding exactness: greedy outputs must be IDENTICAL to
+plain cached generation no matter what the draft proposes — perfect
+draft (self), realistic draft (int8 of the same weights), and an
+adversarial unrelated draft (near-zero acceptance)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models import Llama, LlamaConfig
+from sparkdl_tpu.models.generate import generate
+from sparkdl_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96)
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 7)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    return cfg, model, params, prompt
+
+
+def test_self_draft_accepts_everything(setup):
+    """Draft == target: every proposal verifies, rounds ≈ n/(k+1),
+    output exactly equals plain greedy generation."""
+    cfg, model, params, prompt = setup
+    n = 24
+    ref = generate(model, params, prompt, max_new_tokens=n,
+                   temperature=0.0)
+    out, stats = speculative_generate(
+        model, params, params, prompt, max_new_tokens=n, k=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["accepted"] == stats["proposed"]  # perfect draft
+    # k+1 tokens per round on full acceptance
+    assert stats["rounds"] <= -(-n // 5) + 1
+
+
+def test_int8_draft_is_exact(setup):
+    """The natural production pairing: int8 weights draft for the full
+    precision target. Output must still be the target's exact greedy
+    decode, with acceptance tracked."""
+    from sparkdl_tpu.models.quant import quantize_llama_params
+
+    cfg, model, params, prompt = setup
+    q_tree = quantize_llama_params(params)
+    draft = Llama(dataclasses.replace(cfg, quant="int8"))
+    n = 20
+    ref = generate(model, params, prompt, max_new_tokens=n,
+                   temperature=0.0)
+    out, stats = speculative_generate(
+        model, params, q_tree, prompt, max_new_tokens=n, k=4,
+        draft_model=draft)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["rounds"] >= 1
+    assert 0 <= stats["accepted"] <= stats["proposed"]
+
+
+def test_adversarial_draft_still_exact(setup):
+    """A draft with UNRELATED weights proposes garbage; acceptance is
+    ~0, every round still yields >= 1 verified token, and the output is
+    byte-identical to plain generation."""
+    cfg, model, params, prompt = setup
+    other = Llama(cfg).init(jax.random.PRNGKey(123), prompt)["params"]
+    n = 12
+    ref = generate(model, params, prompt, max_new_tokens=n,
+                   temperature=0.0)
+    out, stats = speculative_generate(
+        model, params, other, prompt, max_new_tokens=n, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # worst case: one target token per round
+    assert stats["rounds"] <= n
+
+
+def test_exact_at_cache_capacity_boundary(setup):
+    """Regression (round-4 review repro): speculation scratch writes up
+    to k positions past the final token; without headroom the clamped
+    cache writes corrupted history and broke exactness. The guard must
+    demand p_len + max_new + k <= max_cache_len, and decoding right AT
+    the allowed boundary must stay exact."""
+    cfg, model, params, _ = setup
+    cfg40 = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=40)
+    model40 = Llama(cfg40)
+    rng = np.random.default_rng(7)
+    prompt = jnp.asarray(rng.integers(0, cfg40.vocab_size, (2, 8)),
+                         jnp.int32)
+    params40 = model40.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    with pytest.raises(ValueError, match="speculation scratch"):
+        speculative_generate(model40, params40, params40, prompt,
+                             max_new_tokens=32, k=4)
+
+    n = 40 - 8 - 4  # exactly at the boundary
+    ref = generate(model40, params40, prompt, max_new_tokens=n,
+                   temperature=0.0)
+    out, stats = speculative_generate(
+        model40, params40, params40, prompt, max_new_tokens=n, k=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["accepted"] == stats["proposed"]  # self-draft: perfect
+
+
+def test_eos_truncation_matches_generate(setup):
+    # batch 1: any loop-generated token is a valid eos candidate
+    cfg, model, params, prompt = setup
+    prompt = prompt[:1]
+    n = 16
+    ref = np.asarray(generate(model, params, prompt, max_new_tokens=n,
+                              temperature=0.0))
+    eos = int(ref[0, prompt.shape[1] + 5])  # fires mid-sequence
+    ref_eos = np.asarray(generate(model, params, prompt,
+                                  max_new_tokens=n, temperature=0.0,
+                                  eos_id=eos))
+    out, _ = speculative_generate(
+        model, params, params, prompt, max_new_tokens=n, k=4,
+        eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out), ref_eos)
