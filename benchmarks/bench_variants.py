@@ -29,8 +29,7 @@ def measure(attention, batch, seq, remat=False, n_steps=20,
 
     from sparkdl_tpu.models import Llama, LlamaConfig, lora_mask
     from sparkdl_tpu.parallel.train import (
-        cross_entropy_loss,
-        fused_cross_entropy,
+        make_lm_loss_fn,
         make_train_step,
     )
 
@@ -46,19 +45,10 @@ def measure(attention, batch, seq, remat=False, n_steps=20,
     opt = optax.masked(optax.adamw(1e-4), mask)
     opt_state = opt.init(params)
 
-    if loss == "fused":
-        def loss_fn(p, b):
-            hidden = model.apply({"params": p}, b["inputs"],
-                                 return_hidden=True)
-            return fused_cross_entropy(
-                hidden, p["lm_head"]["kernel"], b["targets"],
-                chunk_size=chunk, freeze_head=True,
-                matmul_dtype=jnp.bfloat16 if ce_bf16 else None,
-            )
-    else:
-        def loss_fn(p, b):
-            logits = model.apply({"params": p}, b["inputs"])
-            return cross_entropy_loss(logits, b["targets"])
+    # Shared with bench.py: what the sweep measures is byte-for-byte
+    # what a promoted.json makes the headline run.
+    loss_fn = make_lm_loss_fn(model, loss=loss, chunk=chunk,
+                              ce_bf16=ce_bf16)
 
     step = make_train_step(loss_fn, opt, param_mask=mask, remat=remat)
     rng = np.random.default_rng(0)

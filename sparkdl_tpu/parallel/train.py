@@ -199,6 +199,37 @@ def global_batch(rng, vocab, batch, seq):
     return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
 
 
+def make_lm_loss_fn(model, *, loss="logits", chunk=512, ce_bf16=False):
+    """The language-model loss closure used by BOTH the headline bench
+    and the bench_variants sweep — one definition, so a variant the
+    sweep measured is exactly what a promotion into bench.py runs.
+
+    ``loss="logits"``: materialized logits + standard CE.
+    ``loss="fused"``: hidden states into :func:`fused_cross_entropy`
+    (chunked unembed+CE, frozen head, optional bf16 unembed matmul) —
+    the (B,S,V) fp32 logits tensor never hits HBM.
+    """
+    import jax.numpy as jnp
+
+    if loss == "fused":
+        def loss_fn(p, b):
+            hidden = model.apply({"params": p}, b["inputs"],
+                                 return_hidden=True)
+            return fused_cross_entropy(
+                hidden, p["lm_head"]["kernel"], b["targets"],
+                chunk_size=chunk, freeze_head=True,
+                matmul_dtype=jnp.bfloat16 if ce_bf16 else None,
+            )
+        return loss_fn
+    if loss != "logits":
+        raise ValueError(f"unknown loss path {loss!r}")
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["inputs"])
+        return cross_entropy_loss(logits, b["targets"])
+    return loss_fn
+
+
 def param_count(params):
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 

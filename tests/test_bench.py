@@ -231,6 +231,56 @@ class TestKillOwnStale:
         assert kills == []
 
 
+@pytest.mark.gang
+def test_bench_promoted_variant_config(tmp_path):
+    """A committed promoted.json redirects the headline measurement
+    (fused-CE loss path here) without code changes; the emitted record
+    names the promotion."""
+    promo = tmp_path / "promoted.json"
+    promo.write_text(json.dumps(
+        {"attention": "reference", "loss": "fused", "chunk": 64}))
+    r = _run({
+        "SPARKDL_TPU_BENCH_PLATFORM": "cpu",
+        "SPARKDL_TPU_BENCH_TINY": "1",
+        "SPARKDL_TPU_BENCH_PROMOTED": str(promo),
+    })
+    assert r.returncode == 0, r.stderr[-800:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] > 0
+    assert out["promoted"]["loss"] == "fused"
+
+
+def test_bench_promoted_failures_are_loud(tmp_path):
+    """A promotion that EXISTS but is broken must fail the bench, not
+    silently measure the default config under the promoted label."""
+    bad = tmp_path / "promoted.json"
+    env_base = {
+        "SPARKDL_TPU_BENCH_PLATFORM": "cpu",
+        "SPARKDL_TPU_BENCH_TINY": "1",
+        "SPARKDL_TPU_BENCH_PROMOTED": str(bad),
+    }
+    bad.write_text("{not json")
+    r = _run(env_base, timeout=120)
+    assert r.returncode != 0
+    assert "unreadable promoted config" in r.stderr
+
+    bad.write_text(json.dumps({"attention": "falsh"}))  # typo
+    r = _run(env_base, timeout=120)
+    assert r.returncode != 0
+    assert "attention='falsh'" in r.stderr
+
+    bad.write_text(json.dumps({"atention": "flash"}))  # unknown key
+    r = _run(env_base, timeout=120)
+    assert r.returncode != 0
+    assert "unknown promoted.json keys" in r.stderr
+
+    r = _run({**env_base,
+              "SPARKDL_TPU_BENCH_PROMOTED": str(tmp_path / "nope.json")},
+             timeout=120)
+    assert r.returncode != 0
+    assert "does not exist" in r.stderr
+
+
 def test_bench_fails_fast_when_backend_unavailable():
     # an unknown platform name fails backend init on every host; the
     # orchestrator must emit an error JSON line and exit nonzero
