@@ -204,6 +204,73 @@ def maybe_launch_on_spark(num_workers, main, kwargs, driver_log_verbosity):
                             driver_log_verbosity)
 
 
+def maybe_transform_on_spark(dataset, get_broadcast, extra_cols):
+    """Executor-side model inference via ``mapInPandas``: pandas
+    batches flow over Arrow straight into the model's pandas->pandas
+    closure — no Row pickling, no per-cell dtype coercion (Arrow +
+    the EXPLICIT output schema handle numpy dtypes and nulls), and no
+    schema-inference job running inference on a sampled partition.
+    Prediction is embarrassingly parallel, so unlike training this
+    needs no gang, no coordinator, and tolerates Spark's per-task
+    retries. The driver never materializes the dataset (reference
+    ``xgboost.py:81-97`` — the large-data contract cuts both ways: a
+    fit that never collects is defeated by a transform that does).
+
+    ``get_broadcast(spark)``: returns a Broadcast of the CLOUDPICKLED
+    closure (bytes — Spark's broadcast serializer is plain pickle,
+    which the model's Param lambdas defeat) — owned by the CALLER
+    (the model), which caches it so repeated transforms reuse one
+    executor-resident model copy instead of leaking one per call.
+    ``extra_cols``: ``[(name, "double" | "array<double>"), ...]``
+    appended by the closure.
+
+    Returns None when no active SparkSession exists (caller falls back
+    to driver-side pandas)."""
+    spark = SparkSession.getActiveSession()
+    if spark is None:
+        return None
+    # Arrow (mapInPandas' transport) cannot convert UDT columns —
+    # pyspark.ml Vector features among them. The driver-side pandas
+    # path handles those (extract_matrix understands Vector cells), so
+    # fall back rather than fail at action time.
+    if any(type(f.dataType).__name__.endswith("UDT")
+           for f in dataset.schema.fields):
+        return None
+    from pyspark.sql.types import (
+        ArrayType,
+        DoubleType,
+        StructField,
+        StructType,
+    )
+
+    # Input columns colliding with the prediction columns are REPLACED
+    # (the pandas path's overwrite semantics) — duplicated field names
+    # would make every select on them ambiguous.
+    extra_names = {name for name, _ in extra_cols}
+    schema = StructType(
+        [f for f in dataset.schema.fields if f.name not in extra_names]
+        + [
+            StructField(
+                name,
+                ArrayType(DoubleType()) if typ == "array<double>"
+                else DoubleType(),
+                True,
+            )
+            for name, typ in extra_cols
+        ])
+    names = [f.name for f in schema.fields]
+    bc = get_broadcast(spark)
+
+    def run(batches):
+        import cloudpickle as _cp
+
+        fn = _cp.loads(bc.value)  # once per partition task
+        for pdf in batches:
+            yield fn(pdf)[names]
+
+    return dataset.mapInPandas(run, schema)
+
+
 def maybe_launch_estimator_on_spark(dataset, num_workers, main, kwargs,
                                     driver_log_verbosity,
                                     force_repartition=False):

@@ -673,16 +673,84 @@ class _XgboostModel(Model, _XgboostParams, MLReadable, MLWritable):
         """Gain-based per-feature importances (xgboost sklearn parity)."""
         return self._xgb_model.feature_importances("gain")
 
-    def _transform(self, dataset):
-        pdf, spark_template = to_pandas(dataset)
+    def _transform_pandas(self, pdf):
+        """pandas -> pandas with prediction columns appended — the one
+        inference body, run driver-side for pandas inputs and
+        executor-side per partition for Spark inputs."""
         pdf = pdf.copy()
         X = extract_matrix(pdf, self.getFeaturesCol())
         margins = self._xgb_model.predict_margin(X)
         self._add_prediction_cols(pdf, margins)
-        return to_output(pdf, spark_template)
+        return pdf
+
+    def _transform(self, dataset):
+        from sparkdl_tpu.ml.dataframe import is_spark_df
+
+        if is_spark_df(dataset):
+            # Distributed inference: partitions stay executor-resident
+            # (the reference's large-data contract, xgboost.py:81-97).
+            try:
+                from sparkdl_tpu.horovod.spark_backend import (
+                    maybe_transform_on_spark,
+                )
+            except ImportError:
+                pass
+            else:
+                out = maybe_transform_on_spark(
+                    dataset, self._transform_broadcast,
+                    self._prediction_schema())
+                if out is not None:
+                    return out
+        pdf, spark_template = to_pandas(dataset)
+        return to_output(self._transform_pandas(pdf), spark_template)
 
     def _add_prediction_cols(self, pdf, margins):
         raise NotImplementedError
+
+    def _prediction_schema(self):
+        """[(column, spark type)] appended by ``_add_prediction_cols``
+        — the distributed transform builds its output schema from this
+        instead of running a schema-inference job."""
+        raise NotImplementedError
+
+    def __getstate__(self):
+        """Pickling (closure shipping, broadcast, persistence helpers)
+        must never drag the context-bound Broadcast cache along: a
+        pickled Broadcast re-registers into ITS context, which may be
+        stopped — and the broadcast of this very model would recurse
+        into the previous one."""
+        state = dict(self.__dict__)
+        state.pop("_bc", None)
+        state.pop("_bc_sc_id", None)
+        return state
+
+    def _transform_broadcast(self, spark):
+        """Broadcast of the inference closure (carrying this model's
+        booster), cached per SparkContext: repeated transforms reuse
+        ONE executor-resident model copy instead of leaking one per
+        call. A context change (session restart) re-broadcasts and
+        releases the stale copy. Keyed by applicationId — an id()
+        could be reused by a new context allocated at a dead one's
+        address."""
+        import cloudpickle
+
+        sc = spark.sparkContext
+        key = getattr(sc, "applicationId", None) or id(sc)
+        if self.__dict__.get("_bc_sc_id") != key:
+            stale = self.__dict__.pop("_bc", None)
+            self.__dict__.pop("_bc_sc_id", None)
+            if stale is not None:
+                try:
+                    stale.unpersist()
+                except Exception:  # context already gone
+                    pass
+            # cloudpickle BYTES, not the closure itself: Spark's
+            # broadcast serializer is plain pickle, which rejects the
+            # lambdas inside the Param machinery this model carries
+            self._bc = sc.broadcast(
+                cloudpickle.dumps(self._transform_pandas))
+            self._bc_sc_id = key
+        return self._bc
 
     def _save_impl(self, path):
         with open(os.path.join(path, "model.json"), "w") as fh:
@@ -712,6 +780,9 @@ class XgboostRegressorModel(_XgboostModel):
     def _add_prediction_cols(self, pdf, margins):
         pdf[self.getPredictionCol()] = margins[:, 0].astype(np.float64)
 
+    def _prediction_schema(self):
+        return [(self.getPredictionCol(), "double")]
+
 
 class XgboostClassifierModel(_XgboostModel, HasProbabilityCol,
                              HasRawPredictionCol):
@@ -738,6 +809,11 @@ class XgboostClassifierModel(_XgboostModel, HasProbabilityCol,
         pdf[self.getRawPredictionCol()] = list(raw.astype(np.float64))
         pdf[self.getProbabilityCol()] = list(proba.astype(np.float64))
         pdf[self.getPredictionCol()] = proba.argmax(axis=1).astype(np.float64)
+
+    def _prediction_schema(self):
+        return [(self.getRawPredictionCol(), "array<double>"),
+                (self.getProbabilityCol(), "array<double>"),
+                (self.getPredictionCol(), "double")]
 
 
 class XgboostRegressor(_XgboostEstimator):

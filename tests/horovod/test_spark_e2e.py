@@ -124,6 +124,15 @@ def test_estimator_trains_partition_resident(spark, monkeypatch):
     model = XgboostClassifier(
         num_workers=2, n_estimators=8, max_depth=3
     ).fit(df)
+
+    # transform is distributed too: executor-side partition inference,
+    # a Spark DataFrame back — toPandas STILL poisoned
+    rows = model.transform(df).collect()
+    assert len(rows) == n
+    acc_dist = float(np.mean([
+        float(r["prediction"]) == float(r["label"]) for r in rows
+    ]))
+    assert acc_dist > 0.9
     monkeypatch.undo()
 
     # The model predicts the separating rule well above chance.

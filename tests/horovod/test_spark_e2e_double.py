@@ -127,7 +127,16 @@ def test_estimator_trains_partition_resident(minispark, monkeypatch):
     model = XgboostClassifier(
         num_workers=2, n_estimators=8, max_depth=3
     ).fit(df)
+
+    # transform is distributed too: executor-side partition inference,
+    # a Spark DataFrame back — toPandas STILL poisoned
+    rows = model.transform(df).collect()
     monkeypatch.undo()
+    assert len(rows) == n
+    acc = float(np.mean([
+        float(r["prediction"]) == float(r["label"]) for r in rows
+    ]))
+    assert acc > 0.9
 
     import pandas as pd
 

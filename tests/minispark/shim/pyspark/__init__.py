@@ -146,8 +146,9 @@ class BarrierTaskContext:
 
 
 class Row:
-    def __init__(self, fields):
-        self._fields = dict(fields)
+    def __init__(self, fields=None, **kw):
+        # real pyspark: Row(**kwargs); internal: Row(dict)
+        self._fields = dict(fields or {}, **kw)
 
     def asDict(self):
         return dict(self._fields)
@@ -247,9 +248,32 @@ class _BarrierJob:
         self._fn = fn
 
 
+class _Broadcast:
+    def __init__(self, value):
+        self.value = value
+
+    def unpersist(self, blocking=False):
+        pass
+
+    def destroy(self, blocking=False):
+        self.value = None
+
+
 class _SparkContext:
+    _app_counter = 0
+
     def __init__(self, n_slots):
         self.defaultParallelism = n_slots
+        _SparkContext._app_counter += 1
+        self.applicationId = f"minispark-{_SparkContext._app_counter}"
+
+    def broadcast(self, value):
+        # in-process double: no wire to cross, but pickle/unpickle for
+        # fidelity — a value that real Spark could not broadcast
+        # (e.g. one dragging a context-bound handle) must fail HERE
+        import pickle as _pickle
+
+        return _Broadcast(_pickle.loads(_pickle.dumps(value)))
 
     def parallelize(self, data, num_partitions):
         data = list(data)
@@ -268,3 +292,22 @@ class _RDD:
 
     def barrier(self):
         return _BarrierRDD(self._partitions)
+
+    def mapPartitions(self, fn):
+        """Plain (non-barrier) mapPartitions. In-process in the
+        double: no gang semantics to reproduce — per-partition
+        isolation is what the tests assert, and fn receives only its
+        own partition's rows either way."""
+        return _MappedRDD(self._partitions, fn)
+
+
+class _MappedRDD:
+    def __init__(self, partitions, fn):
+        self._partitions = partitions
+        self._fn = fn
+
+    def collect(self):
+        out = []
+        for part in self._partitions:
+            out.extend(self._fn(iter(list(part))))
+        return out

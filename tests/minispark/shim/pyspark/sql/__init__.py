@@ -1,0 +1,154 @@
+"""pyspark.sql TEST DOUBLE — see tests/minispark/README.md."""
+
+from pyspark import Row, _MappedRDD, _RDD, _SparkContext
+
+__all__ = ["DataFrame", "Row", "SparkSession"]
+
+
+class DataFrame:
+    """Pandas-backed, partitioned. __module__ is 'pyspark.sql', so
+    sparkdl_tpu.ml.dataframe.is_spark_df detects it like the real one."""
+
+    def __init__(self, pdf, n_partitions, columns=None):
+        self._pdf = pdf.reset_index(drop=True)
+        self._n = max(1, int(n_partitions))
+        if columns is not None:
+            self._pdf.columns = list(columns)
+
+    # -- surface the backend drives -----------------------------------
+    @property
+    def schema(self):
+        """StructType inferred from pandas dtypes + cell samples (the
+        real thing carries the writer's schema; dtype inference is
+        enough for the double's test surface)."""
+        import numpy as _np
+
+        from pyspark.sql.types import (
+            ArrayType,
+            BooleanType,
+            DoubleType,
+            LongType,
+            StringType,
+            StructField,
+            StructType,
+        )
+
+        fields = []
+        for col in self._pdf.columns:
+            s = self._pdf[col]
+            if s.dtype == bool:
+                t = BooleanType()
+            elif _np.issubdtype(s.dtype, _np.integer):
+                t = LongType()
+            elif _np.issubdtype(s.dtype, _np.floating):
+                t = DoubleType()
+            elif len(s) and isinstance(s.iloc[0], (list, _np.ndarray)):
+                t = ArrayType(DoubleType())
+            else:
+                t = StringType()
+            fields.append(StructField(col, t, True))
+        return StructType(fields)
+
+    @property
+    def sparkSession(self):
+        return SparkSession.getActiveSession()
+
+    @property
+    def rdd(self):
+        rows = [
+            Row(rec) for rec in self._pdf.to_dict(orient="records")
+        ]
+        parts = [[] for _ in range(self._n)]
+        n_rows = len(rows)
+        per = (n_rows + self._n - 1) // self._n if n_rows else 0
+        for i, r in enumerate(rows):
+            parts[min(i // per, self._n - 1) if per else 0].append(r)
+        return _RDD(parts)
+
+    def repartition(self, n):
+        # real repartition shuffles; round-robin is enough for a double
+        return DataFrame(self._pdf, n)
+
+    def mapInPandas(self, func, schema):
+        """Per-partition pandas batches through ``func`` (in-process in
+        the double; real Spark streams Arrow batches per partition)."""
+        import pandas as pd
+
+        n_rows = len(self._pdf)
+        per = (n_rows + self._n - 1) // self._n if n_rows else 0
+        parts = [
+            self._pdf.iloc[i * per:(i + 1) * per]
+            for i in range(self._n)
+        ] if per else [self._pdf]
+        outs = []
+        for part in parts:
+            if len(part):
+                outs.extend(func(iter([part.reset_index(drop=True)])))
+        names = [f.name for f in schema.fields]
+        out = (pd.concat(outs, ignore_index=True)[names]
+               if outs else pd.DataFrame(columns=names))
+        return DataFrame(out, self._n)
+
+    def select(self, col):
+        return DataFrame(self._pdf[[col]].copy(), self._n)
+
+    def distinct(self):
+        return DataFrame(self._pdf.drop_duplicates(), self._n)
+
+    def collect(self):
+        return [Row(rec) for rec in self._pdf.to_dict(orient="records")]
+
+    def toPandas(self):
+        return self._pdf.copy()
+
+
+class SparkSession:
+    _active = None
+
+    def __init__(self, n_slots=2):
+        self.sparkContext = _SparkContext(n_slots)
+
+    @classmethod
+    def getActiveSession(cls):
+        return cls._active
+
+    # test helper (the real builder API is out of scope for the double)
+    @classmethod
+    def _activate(cls, n_slots=2):
+        cls._active = cls(n_slots)
+        return cls._active
+
+    @classmethod
+    def _deactivate(cls):
+        cls._active = None
+
+    def createDataFrame(self, rows, schema=None):
+        import pandas as pd
+
+        from pyspark.sql.types import StructType
+
+        if isinstance(rows, (_RDD, _MappedRDD)):
+            # RDD input (the distributed-transform path): tuples or
+            # Rows, with an explicit StructType naming the columns
+            data = rows.collect()
+            if isinstance(schema, StructType):
+                names = [f.name for f in schema.fields]
+                recs = [
+                    r.asDict() if hasattr(r, "asDict")
+                    else dict(zip(names, r))
+                    for r in data
+                ]
+                pdf = pd.DataFrame(recs, columns=names)
+            else:
+                pdf = pd.DataFrame([r.asDict() for r in data])
+        elif isinstance(rows, pd.DataFrame):
+            # real pyspark accepts a pandas frame with no schema
+            pdf = rows.copy()
+        else:
+            columns = (
+                [f.name for f in schema.fields]
+                if isinstance(schema, StructType)
+                else list(schema) if schema is not None else None
+            )
+            pdf = pd.DataFrame(list(rows), columns=columns)
+        return DataFrame(pdf, self.sparkContext.defaultParallelism)

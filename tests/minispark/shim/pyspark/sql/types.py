@@ -1,0 +1,58 @@
+"""pyspark.sql.types TEST DOUBLE — the minimal type objects the
+distributed-transform path constructs and inspects."""
+
+
+class DataType:
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class DoubleType(DataType):
+    pass
+
+
+class FloatType(DataType):
+    pass
+
+
+class IntegerType(DataType):
+    pass
+
+
+class LongType(DataType):
+    pass
+
+
+class BooleanType(DataType):
+    pass
+
+
+class StringType(DataType):
+    pass
+
+
+class ArrayType(DataType):
+    def __init__(self, elementType, containsNull=True):
+        self.elementType = elementType
+        self.containsNull = containsNull
+
+
+class StructField:
+    def __init__(self, name, dataType, nullable=True):
+        self.name = name
+        self.dataType = dataType
+        self.nullable = nullable
+
+    def __repr__(self):
+        return f"StructField({self.name},{self.dataType!r})"
+
+
+class StructType:
+    def __init__(self, fields=None):
+        self.fields = list(fields or [])
+
+    def __iter__(self):
+        return iter(self.fields)
