@@ -230,11 +230,20 @@ def maybe_transform_on_spark(dataset, get_broadcast, extra_cols):
     if spark is None:
         return None
     # Arrow (mapInPandas' transport) cannot convert UDT columns —
-    # pyspark.ml Vector features among them. The driver-side pandas
-    # path handles those (extract_matrix understands Vector cells), so
-    # fall back rather than fail at action time.
-    if any(type(f.dataType).__name__.endswith("UDT")
-           for f in dataset.schema.fields):
+    # pyspark.ml Vector features among them, at ANY nesting depth
+    # (array<Vector>, struct fields...). The driver-side pandas path
+    # handles those (extract_matrix understands Vector cells), so fall
+    # back rather than fail at action time.
+    def _has_udt(dt):
+        if type(dt).__name__.endswith("UDT"):
+            return True
+        if hasattr(dt, "elementType"):
+            return _has_udt(dt.elementType)
+        if hasattr(dt, "fields"):
+            return any(_has_udt(f.dataType) for f in dt.fields)
+        return False
+
+    if any(_has_udt(f.dataType) for f in dataset.schema.fields):
         return None
     from pyspark.sql.types import (
         ArrayType,

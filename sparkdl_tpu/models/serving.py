@@ -74,6 +74,19 @@ def _engine_programs(dec_cfg, temperature):
         return state["cache"], _sample(last, rng)
 
     @jax.jit
+    def suffix_prefill(params, prefix_cache, padded_suffix, rng,
+                       true_len):
+        # prefix caching: continue a STORED prefix cache (its shared
+        # index already sits at the prefix length) over the request's
+        # suffix only — the prefix rows are copied, never recomputed
+        logits, state = model.apply(
+            {"params": params, "cache": prefix_cache}, padded_suffix,
+            mutable=["cache"],
+        )
+        last = logits[:, true_len - 1]
+        return state["cache"], _sample(last, rng)
+
+    @jax.jit
     def insert(cache, pos, token, one_cache, new_token, p_len, slot):
         # scalar leaves (the shared cache_index, unused on the
         # slot-mapped path) pass through; K/V rows land in the slot
@@ -108,7 +121,7 @@ def _engine_programs(dec_cfg, temperature):
         )
         return cache, token, pos, rng, toks  # toks: (n, n_slots)
 
-    return prefill, insert, decode_chunk
+    return prefill, suffix_prefill, insert, decode_chunk
 
 
 @dataclasses.dataclass
@@ -150,7 +163,8 @@ class ContinuousBatchingEngine:
         from sparkdl_tpu.models.llama import Llama
 
         self._model = Llama(self.cfg)
-        self._queue = []          # (req_id, prompt np.ndarray, max_new)
+        self._queue = []    # (req_id, prompt, max_new, prefix_id)
+        self._prefixes = {}  # prefix_id -> (tokens, prefilled cache)
         self._slots = [_Slot() for _ in range(self.n_slots)]
         self._results = {}
         self._next_id = 0
@@ -218,15 +232,55 @@ class ContinuousBatchingEngine:
         return self._programs[0]
 
     @property
-    def _insert_fn(self):
+    def _suffix_prefill_fn(self):
         return self._programs[1]
 
     @property
-    def _decode_chunk_fn(self):
+    def _insert_fn(self):
         return self._programs[2]
 
-    def submit(self, prompt_tokens, max_new_tokens):
-        """Queue a request; returns its id."""
+    @property
+    def _decode_chunk_fn(self):
+        return self._programs[3]
+
+    def register_prefix(self, prefix_tokens):
+        """Prefill a shared prompt PREFIX (a system prompt) once and
+        cache its K/V rows; requests submitted with the returned
+        ``prefix_id`` prefill only their suffix — admission cost drops
+        from O(full prompt) to O(suffix) compute plus a device-side
+        row copy."""
+        prefix = np.asarray(prefix_tokens, np.int32).reshape(-1)
+        if not len(prefix):
+            raise ValueError("empty prefix")
+        # < (not <=): a prefix filling the whole cache leaves no room
+        # for even a one-token suffix, so it could never be used
+        if len(prefix) >= self.cfg.max_cache_len:
+            raise ValueError(
+                f"prefix ({len(prefix)}) must be shorter than "
+                f"max_cache_len ({self.cfg.max_cache_len})"
+            )
+        p_len = len(prefix)
+        bucket = min(_bucket(p_len), self.cfg.max_cache_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p_len] = prefix
+        self._rng, sub = jax.random.split(self._rng)
+        cache, _ = self._prefill_fn(
+            self.params, jnp.asarray(padded), sub, p_len
+        )
+        # pin the shared index to the TRUE length (the bucket-padded
+        # prefill advanced it to the bucket; junk rows beyond p_len
+        # stay invisible and get overwritten by the suffix)
+        cache = jax.tree.map(
+            lambda x: jnp.full(x.shape, p_len, x.dtype)
+            if x.ndim == 0 else x, cache)
+        pid = f"prefix-{len(self._prefixes)}"
+        self._prefixes[pid] = (prefix, cache)
+        return pid
+
+    def submit(self, prompt_tokens, max_new_tokens, prefix_id=None):
+        """Queue a request; returns its id. ``prefix_id`` (from
+        :meth:`register_prefix`): the prompt must START with that
+        prefix and extend it by at least one token."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError(
@@ -238,21 +292,48 @@ class ContinuousBatchingEngine:
                 f"({max_new_tokens}) exceeds max_cache_len "
                 f"({self.cfg.max_cache_len})"
             )
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(
+                    f"unknown prefix_id {prefix_id!r}; call "
+                    "register_prefix first"
+                )
+            prefix, _ = self._prefixes[prefix_id]
+            if (len(prompt) <= len(prefix)
+                    or not np.array_equal(prompt[:len(prefix)], prefix)):
+                raise ValueError(
+                    f"prompt must extend the registered prefix "
+                    f"{prefix_id} by at least one token"
+                )
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, prompt, int(max_new_tokens)))
+        self._queue.append((rid, prompt, int(max_new_tokens), prefix_id))
         return rid
 
     def _admit(self, slot_idx):
-        rid, prompt, max_new = self._queue.pop(0)
+        rid, prompt, max_new, prefix_id = self._queue.pop(0)
         p_len = len(prompt)
-        bucket = min(_bucket(p_len), self.cfg.max_cache_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p_len] = prompt
         self._rng, sub = jax.random.split(self._rng)
-        one_cache, tok = self._prefill_fn(
-            self.params, jnp.asarray(padded), sub, p_len
-        )
+        if prefix_id is not None:
+            prefix, prefix_cache = self._prefixes[prefix_id]
+            suffix = prompt[len(prefix):]
+            bucket = min(_bucket(len(suffix)),
+                         self.cfg.max_cache_len - len(prefix))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(suffix)] = suffix
+            one_cache, tok = self._suffix_prefill_fn(
+                self.params, prefix_cache, jnp.asarray(padded), sub,
+                len(suffix),
+            )
+            self.stats["prefill_tokens_saved"] = (
+                self.stats.get("prefill_tokens_saved", 0) + len(prefix))
+        else:
+            bucket = min(_bucket(p_len), self.cfg.max_cache_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p_len] = prompt
+            one_cache, tok = self._prefill_fn(
+                self.params, jnp.asarray(padded), sub, p_len
+            )
         self._cache, self._pos, self._token = self._insert_fn(
             self._cache, self._pos, self._token, one_cache, tok,
             p_len, slot_idx,

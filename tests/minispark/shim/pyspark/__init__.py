@@ -255,9 +255,6 @@ class _Broadcast:
     def unpersist(self, blocking=False):
         pass
 
-    def destroy(self, blocking=False):
-        self.value = None
-
 
 class _SparkContext:
     _app_counter = 0
@@ -292,22 +289,3 @@ class _RDD:
 
     def barrier(self):
         return _BarrierRDD(self._partitions)
-
-    def mapPartitions(self, fn):
-        """Plain (non-barrier) mapPartitions. In-process in the
-        double: no gang semantics to reproduce — per-partition
-        isolation is what the tests assert, and fn receives only its
-        own partition's rows either way."""
-        return _MappedRDD(self._partitions, fn)
-
-
-class _MappedRDD:
-    def __init__(self, partitions, fn):
-        self._partitions = partitions
-        self._fn = fn
-
-    def collect(self):
-        out = []
-        for part in self._partitions:
-            out.extend(self._fn(iter(list(part))))
-        return out

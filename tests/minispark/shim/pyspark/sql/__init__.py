@@ -1,6 +1,6 @@
 """pyspark.sql TEST DOUBLE — see tests/minispark/README.md."""
 
-from pyspark import Row, _MappedRDD, _RDD, _SparkContext
+from pyspark import Row, _RDD, _SparkContext
 
 __all__ = ["DataFrame", "Row", "SparkSession"]
 
@@ -127,21 +127,7 @@ class SparkSession:
 
         from pyspark.sql.types import StructType
 
-        if isinstance(rows, (_RDD, _MappedRDD)):
-            # RDD input (the distributed-transform path): tuples or
-            # Rows, with an explicit StructType naming the columns
-            data = rows.collect()
-            if isinstance(schema, StructType):
-                names = [f.name for f in schema.fields]
-                recs = [
-                    r.asDict() if hasattr(r, "asDict")
-                    else dict(zip(names, r))
-                    for r in data
-                ]
-                pdf = pd.DataFrame(recs, columns=names)
-            else:
-                pdf = pd.DataFrame([r.asDict() for r in data])
-        elif isinstance(rows, pd.DataFrame):
+        if isinstance(rows, pd.DataFrame):
             # real pyspark accepts a pandas frame with no schema
             pdf = rows.copy()
         else:

@@ -152,6 +152,37 @@ def test_engine_tensor_parallel_matches_single_device(setup):
         np.testing.assert_array_equal(b, t)
 
 
+def test_prefix_caching_is_exact_and_saves_prefill(setup):
+    """A registered prefix (system prompt) is prefilled once; requests
+    extending it prefill only their suffix — tokens identical to the
+    full-prompt path, savings tracked."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(6)
+    system = rng.integers(0, cfg.vocab_size, (11,)).astype(np.int32)
+    suffixes = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                for n in (4, 6)]
+    prompts = [np.concatenate([system, s]) for s in suffixes]
+
+    base = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4)
+    rids = [base.submit(p, 8) for p in prompts]
+    ref = base.run()
+
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4)
+    pid = eng.register_prefix(system)
+    rids2 = [eng.submit(p, 8, prefix_id=pid) for p in prompts]
+    out = eng.run()
+    for r_ref, r_out in zip(rids, rids2):
+        np.testing.assert_array_equal(ref[r_ref], out[r_out])
+    assert eng.stats["prefill_tokens_saved"] == 2 * len(system)
+
+    # contract: the prompt must actually extend the prefix
+    with pytest.raises(ValueError, match="extend the registered"):
+        eng.submit(system, 4, prefix_id=pid)
+    with pytest.raises(ValueError, match="extend the registered"):
+        eng.submit(np.concatenate([system[::-1], suffixes[0]]), 4,
+                   prefix_id=pid)
+
+
 def test_engine_rejects_oversized_request(setup):
     cfg, model, params = setup
     eng = ContinuousBatchingEngine(model, params, n_slots=1)
