@@ -36,6 +36,13 @@ class LlamaConfig:
     attention: str = "reference"  # "reference" (train) | "flash" (serve)
     decode: bool = False          # KV-cache autoregressive mode
     max_cache_len: int = 2048     # KV-cache capacity for decoding
+    # Paged KV cache (serving): page_size > 0 replaces the per-row
+    # dense cache with a POOLED physical cache of n_pages pages shared
+    # by all batch rows via per-row block tables (vLLM-style, XLA
+    # gather/scatter). Requires the slot-mapped decode path (explicit
+    # positions) and block_tables passed to __call__.
+    page_size: int = 0
+    n_pages: int = 0
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
@@ -135,7 +142,7 @@ class Attention(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions):
+    def __call__(self, x, cos, sin, positions, block_tables=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.n_heads
         b, s, _ = x.shape
@@ -151,6 +158,59 @@ class Attention(nn.Module):
         # current step and attends over the visible prefix. Positions
         # are derived from the cache index — the single source of
         # truth — so RoPE and the mask can never disagree.
+        if cfg.decode and cfg.page_size:
+            # PAGED cache: one pooled physical (n_pages, page, kvh, hd)
+            # store shared by all rows; a row's logical positions map
+            # through its block table to (page, offset). Slot-mapped
+            # only: the caller owns positions AND block tables.
+            if positions is None or block_tables is None:
+                raise ValueError(
+                    "paged decode needs explicit positions and "
+                    "block_tables (the serving engine provides both)"
+                )
+            P = cfg.page_size
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(
+                    (cfg.n_pages, P, cfg.n_kv_heads, head_dim), k.dtype),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(
+                    (cfg.n_pages, P, cfg.n_kv_heads, head_dim), v.dtype),
+            )
+            pos_dec = jnp.asarray(positions, jnp.int32)
+            if pos_dec.ndim == 1:
+                pos_dec = jnp.broadcast_to(pos_dec[None], (b, s))
+            tables = jnp.asarray(block_tables, jnp.int32)  # (b, n_pg)
+            q = apply_rope(q, cos, sin, pos_dec)
+            k = apply_rope(k, cos, sin, pos_dec)
+            # write: logical -> physical scatter
+            page_of = jnp.take_along_axis(
+                tables, pos_dec // P, axis=1)              # (b, s)
+            ck.value = ck.value.at[page_of, pos_dec % P].set(k)
+            cv.value = cv.value.at[page_of, pos_dec % P].set(v)
+            # read: gather each row's pages into its logical view
+            L = tables.shape[1] * P
+            k = ck.value[tables].reshape(b, L, cfg.n_kv_heads, head_dim)
+            v = cv.value[tables].reshape(b, L, cfg.n_kv_heads, head_dim)
+            mask = (jnp.arange(L)[None, None, :]
+                    <= pos_dec[:, :, None])[:, None]       # (b,1,s,L)
+            rep = cfg.n_heads // cfg.n_kv_heads
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            q32 = q.astype(jnp.float32)
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k.astype(jnp.float32)
+            ) * (head_dim ** -0.5)
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
+            ).reshape(b, s, cfg.n_heads * head_dim)
+            return _dense(cfg, cfg.d_model, "o_proj")(o)
+
         if cfg.decode:
             if s > cfg.max_cache_len:
                 raise ValueError(
@@ -274,10 +334,11 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions):
+    def __call__(self, x, cos, sin, positions, block_tables=None):
         cfg = self.cfg
         h = x + Attention(cfg, self.attention_fn, name="attn")(
-            RMSNorm(cfg.rms_eps, name="attn_norm")(x), cos, sin, positions
+            RMSNorm(cfg.rms_eps, name="attn_norm")(x), cos, sin, positions,
+            block_tables=block_tables,
         )
         if self.use_moe:
             from sparkdl_tpu.models.moe import MoEConfig, MoEMLP
@@ -298,7 +359,8 @@ class Llama(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, positions=None, return_hidden=False):
+    def __call__(self, tokens, positions=None, return_hidden=False,
+                 block_tables=None):
         """``return_hidden=True`` skips the lm_head matmul and returns
         the final-norm hidden states — the input contract of
         :func:`sparkdl_tpu.parallel.train.fused_cross_entropy`, which
@@ -327,7 +389,8 @@ class Llama(nn.Module):
             use_moe = (cfg.n_experts > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
             x = block(cfg, self.attention_fn, use_moe,
-                      name=f"layer_{i}")(x, cos, sin, positions)
+                      name=f"layer_{i}")(x, cos, sin, positions,
+                                         block_tables)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         if return_hidden:
             return x

@@ -46,7 +46,9 @@ def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
 
 @functools.lru_cache(maxsize=64)
 def _engine_programs(dec_cfg, temperature):
-    """(prefill, insert, decode_chunk) jitted once per (decode config,
+    """(prefill, suffix_prefill, paged_prefill, insert, decode_chunk)
+    — positional order is load-bearing (the engine's _programs[i]
+    properties index it) — jitted once per (decode config,
     temperature) — module-level like generate._decode_programs, so a
     fresh engine instance reuses compiled programs instead of paying
     XLA again (an engine per request burst is the normal usage)."""
@@ -86,6 +88,23 @@ def _engine_programs(dec_cfg, temperature):
         last = logits[:, true_len - 1]
         return state["cache"], _sample(last, rng)
 
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def paged_prefill(params, cache, padded_prompt, table_row, rng,
+                      true_len, start_pos):
+        """Paged admission: prefill writes STRAIGHT into the pooled
+        physical cache through this slot's block table — there is no
+        per-slot cache to copy afterwards. ``start_pos`` supports
+        future prefix reuse (0 today)."""
+        s = padded_prompt.shape[1]
+        positions = start_pos + jnp.arange(s)[None, :]
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, padded_prompt,
+            positions=positions, block_tables=table_row,
+            mutable=["cache"],
+        )
+        last = logits[:, true_len - 1]
+        return state["cache"], _sample(last, rng)
+
     @jax.jit
     def insert(cache, pos, token, one_cache, new_token, p_len, slot):
         # scalar leaves (the shared cache_index, unused on the
@@ -101,13 +120,14 @@ def _engine_programs(dec_cfg, temperature):
 
     @functools.partial(jax.jit, static_argnums=(6,),
                        donate_argnums=(1,))
-    def decode_chunk(params, cache, token, pos, active, rng, n):
+    def decode_chunk(params, cache, token, pos, active, rng, n,
+                     tables=None):
         def body(carry, _):
             cache, token, pos, rng = carry
             logits, st = model.apply(
                 {"params": params, "cache": cache},
                 token[:, None], positions=pos[:, None],
-                mutable=["cache"],
+                block_tables=tables, mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub)
@@ -121,7 +141,7 @@ def _engine_programs(dec_cfg, temperature):
         )
         return cache, token, pos, rng, toks  # toks: (n, n_slots)
 
-    return prefill, suffix_prefill, insert, decode_chunk
+    return prefill, suffix_prefill, paged_prefill, insert, decode_chunk
 
 
 @dataclasses.dataclass
@@ -147,13 +167,31 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, params, *, n_slots=4, temperature=0.0,
                  eos_id=None, chunk=16, rng=None, mesh=None,
-                 rules=None):
+                 rules=None, page_size=0, n_pages=None):
         """``mesh`` enables tensor-parallel serving: params are placed
         per ``rules`` (default TRANSFORMER_RULES — Megatron column/row
         splits) and the KV cache is sharded over its kv-heads axis on
         the ``model`` mesh axis; GSPMD inserts the collectives in the
-        same jitted programs the single-device engine runs."""
+        same jitted programs the single-device engine runs.
+
+        ``page_size`` > 0 switches to a PAGED KV cache: one pooled
+        physical store of ``n_pages`` pages shared by every slot
+        through per-slot block tables, so memory is sized to the POOL
+        (actual concurrent context), not n_slots × max_cache_len.
+        Admission allocates a request's worst-case pages up front and
+        queues the request when the pool is exhausted (capacity
+        admission control); a finished request's pages return to the
+        pool. Page 0 is a write-only dump for bucket-padding junk.
+        Default ``n_pages`` reproduces dense capacity exactly."""
         cfg = model.cfg
+        self.page_size = int(page_size)
+        self._max_pages = (
+            -(-cfg.max_cache_len // self.page_size) if page_size else 0)
+        if page_size:
+            n_pages = (int(n_pages) if n_pages is not None
+                       else int(n_slots) * self._max_pages + 1)
+            cfg = dataclasses.replace(
+                cfg, page_size=self.page_size, n_pages=n_pages)
         self.cfg = dataclasses.replace(cfg, decode=True)
         self.n_slots = int(n_slots)
         self.temperature = float(temperature)
@@ -171,11 +209,22 @@ class ContinuousBatchingEngine:
         self.stats = {"steps": 0, "active_slot_steps": 0,
                       "total_slot_steps": 0}
 
-        # Device state: batched cache, per-slot position, last token.
+        # Device state: batched (or pooled paged) cache, per-slot
+        # position, last token.
         dummy = jnp.zeros((self.n_slots, 1), jnp.int32)
+        init_kw = {}
+        if self.page_size:
+            init_kw["block_tables"] = jnp.zeros(
+                (self.n_slots, self._max_pages), jnp.int32)
+            # host-side allocator: page 0 reserved as the junk dump
+            self._free_pages = list(range(1, self.cfg.n_pages))
+            self._tables = np.zeros(
+                (self.n_slots, self._max_pages), np.int32)
+            self._slot_pages = [[] for _ in range(self.n_slots)]
         state = self._model.init(jax.random.PRNGKey(0), dummy,
                                  positions=jnp.zeros((self.n_slots, 1),
-                                                     jnp.int32))
+                                                     jnp.int32),
+                                 **init_kw)
         self._cache = state["cache"]
         self._pos = jnp.zeros((self.n_slots,), jnp.int32)
         self._token = jnp.zeros((self.n_slots,), jnp.int32)
@@ -236,12 +285,16 @@ class ContinuousBatchingEngine:
         return self._programs[1]
 
     @property
-    def _insert_fn(self):
+    def _paged_prefill_fn(self):
         return self._programs[2]
 
     @property
-    def _decode_chunk_fn(self):
+    def _insert_fn(self):
         return self._programs[3]
+
+    @property
+    def _decode_chunk_fn(self):
+        return self._programs[4]
 
     def register_prefix(self, prefix_tokens):
         """Prefill a shared prompt PREFIX (a system prompt) once and
@@ -293,6 +346,11 @@ class ContinuousBatchingEngine:
                 f"({self.cfg.max_cache_len})"
             )
         if prefix_id is not None:
+            if self.page_size:
+                raise ValueError(
+                    "prefix caching is not supported with the paged "
+                    "cache yet (page-table sharing is a next step)"
+                )
             if prefix_id not in self._prefixes:
                 raise ValueError(
                     f"unknown prefix_id {prefix_id!r}; call "
@@ -309,6 +367,49 @@ class ContinuousBatchingEngine:
         self._next_id += 1
         self._queue.append((rid, prompt, int(max_new_tokens), prefix_id))
         return rid
+
+    def _try_admit_paged(self, slot_idx):
+        """Paged admission: allocate the request's worst-case pages
+        (whole prompt + budget) from the pool, point the slot's block
+        table at them, prefill straight into the physical pages.
+        Returns False (request left at the queue head) when the pool
+        can't cover it yet — capacity admission control."""
+        rid, prompt, max_new, _ = self._queue[0]
+        p_len = len(prompt)
+        need = -(-(p_len + max_new) // self.page_size)
+        if need > len(self._free_pages):
+            return False
+        self._queue.pop(0)
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self._slot_pages[slot_idx] = pages
+        self._tables[slot_idx] = 0
+        self._tables[slot_idx, :need] = pages
+
+        bucket = min(_bucket(p_len), self.cfg.max_cache_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p_len] = prompt
+        self._rng, sub = jax.random.split(self._rng)
+        self._cache, tok = self._paged_prefill_fn(
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray(self._tables[slot_idx][None]), sub,
+            jnp.asarray(p_len, jnp.int32), jnp.asarray(0, jnp.int32),
+        )
+        self._pos = self._pos.at[slot_idx].set(p_len)
+        self._token = self._token.at[slot_idx].set(tok[0])
+        self._activate_slot(slot_idx, rid, max_new, tok)
+        return True
+
+    def _activate_slot(self, slot_idx, rid, max_new, tok):
+        """Shared admission epilogue: slot bookkeeping + the
+        instant-finish check (first token is eos, or a one-token
+        budget) — ONE definition for both admission paths."""
+        s = self._slots[slot_idx]
+        s.req_id, s.active = rid, True
+        s.remaining = max_new - 1  # the prefill emitted token #1
+        s.tokens = [int(np.asarray(tok)[0])]
+        if (self.eos_id is not None and s.tokens[0] == self.eos_id) \
+                or s.remaining == 0:
+            self._finish(slot_idx)
 
     def _admit(self, slot_idx):
         rid, prompt, max_new, prefix_id = self._queue.pop(0)
@@ -338,29 +439,45 @@ class ContinuousBatchingEngine:
             self._cache, self._pos, self._token, one_cache, tok,
             p_len, slot_idx,
         )
-        s = self._slots[slot_idx]
-        s.req_id, s.active = rid, True
-        s.remaining = max_new - 1  # the prefill emitted token #1
-        s.tokens = [int(np.asarray(tok)[0])]
-        if (self.eos_id is not None and s.tokens[0] == self.eos_id) \
-                or s.remaining == 0:
-            self._finish(slot_idx)
+        self._activate_slot(slot_idx, rid, max_new, tok)
 
     def _finish(self, slot_idx):
         s = self._slots[slot_idx]
         self._results[s.req_id] = np.asarray(s.tokens, np.int32)
         s.active = False
         s.tokens = []
+        if self.page_size:
+            self._free_pages.extend(self._slot_pages[slot_idx])
+            self._slot_pages[slot_idx] = []
+            self._tables[slot_idx] = 0
 
     def run(self, progress=None):
         """Drain the queue; returns {req_id: generated tokens}."""
         while self._queue or any(s.active for s in self._slots):
-            # fill free slots from the queue
+            # fill free slots from the queue (paged: only while the
+            # pool covers the next request's worst case)
             for i, s in enumerate(self._slots):
                 if not s.active and self._queue:
-                    self._admit(i)
+                    if self.page_size:
+                        if not self._try_admit_paged(i):
+                            break
+                    else:
+                        self._admit(i)
             active = np.array([s.active for s in self._slots])
             if not active.any():
+                if self._queue and self.page_size:
+                    need = -(-(len(self._queue[0][1])
+                               + self._queue[0][2]) // self.page_size)
+                    # only a GENUINE shortfall is a dead end: an
+                    # instantly-finished admission (eos/one-token
+                    # budget) also lands here, with pages free again
+                    if need > len(self._free_pages):
+                        raise RuntimeError(
+                            f"paged pool exhausted: request needs "
+                            f"{need} pages, pool has "
+                            f"{len(self._free_pages)} free and nothing "
+                            "left to drain — raise n_pages"
+                        )
                 continue
             # Chunk length: sized to the soonest-finishing active slot
             # (so its replacement isn't kept waiting), then rounded UP
@@ -378,6 +495,8 @@ class ContinuousBatchingEngine:
              toks) = self._decode_chunk_fn(
                 self.params, self._cache, self._token, self._pos,
                 jnp.asarray(active), self._rng, n,
+                tables=(jnp.asarray(self._tables)
+                        if self.page_size else None),
             )
             toks = np.asarray(toks)                 # (n, n_slots)
             self.stats["steps"] += n
