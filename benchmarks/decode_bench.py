@@ -127,10 +127,10 @@ def main():
         reqs = [(64 + 16 * (i % 5), 128 + 64 * (i % 4))
                 for i in range(24)]
 
-    def build_engine(seed):
+    def build_engine(seed, page_size=0):
         gen = np.random.default_rng(seed)
         eng = ContinuousBatchingEngine(model, params, n_slots=n_slots,
-                                       chunk=chunk)
+                                       chunk=chunk, page_size=page_size)
         for p, nt in reqs:
             eng.submit(
                 gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32), nt
@@ -153,6 +153,27 @@ def main():
         "n_slots": n_slots, "chunk": chunk, "requests": len(reqs),
         "generated_tokens": total_new,
         "slot_utilization": round(eng.stats["utilization"], 3),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+    # Paged cache: same request stream through the pooled-page engine
+    # — the dense-vs-paged throughput delta is the price of the
+    # gather/scatter indirection (the payoff is pool-sized memory).
+    page_size = 16 if os.environ.get("SPARKDL_TPU_BENCH_TINY") else 64
+
+    build_engine(1, page_size).run()  # warm
+    eng_p = build_engine(1, page_size)
+    t0 = time.perf_counter()
+    results_p = eng_p.run()
+    dt_p = time.perf_counter() - t0
+    total_p = sum(len(v) for v in results_p.values())
+    print(json.dumps({
+        "metric": "llama_decode_paged_tokens_per_sec",
+        "value": round(total_p / dt_p, 1),
+        "unit": "tokens/sec",
+        "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
+        "n_pages": eng_p.cfg.n_pages,
+        "vs_dense_engine": round((total_p / dt_p) / (total_new / dt), 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
