@@ -45,8 +45,11 @@ def main(tiny=True):
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
 
+    # Paged pool (drop page_size for the dense slot cache); a shared
+    # system prompt registered once via prefix caching is the third
+    # serving feature — shown on the dense engine below.
     eng = ContinuousBatchingEngine(model, params, n_slots=n_slots,
-                                   chunk=chunk)
+                                   chunk=chunk, page_size=16)
     rids = [
         eng.submit(gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32),
                    budget)
@@ -56,7 +59,24 @@ def main(tiny=True):
     for rid in rids:
         print(f"request {rid}: {len(results[rid])} tokens "
               f"-> {results[rid][:8].tolist()}...")
-    print(f"stats: {eng.stats}")
+    print(f"paged stats: {eng.stats}")
+
+    # prefix caching: the system prompt prefills once
+    eng2 = ContinuousBatchingEngine(model, params, n_slots=n_slots,
+                                    chunk=chunk)
+    system = gen.integers(0, cfg.vocab_size, (12,)).astype(np.int32)
+    pid = eng2.register_prefix(system)
+    rids2 = [
+        eng2.submit(
+            np.concatenate(
+                [system,
+                 gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32)]),
+            budget, prefix_id=pid)
+        for p, budget in reqs[:2]
+    ]
+    out2 = eng2.run()
+    print(f"prefix-cached: {[len(out2[r]) for r in rids2]} tokens, "
+          f"saved {eng2.stats['prefill_tokens_saved']} prefill tokens")
 
 
 if __name__ == "__main__":

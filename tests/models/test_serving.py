@@ -244,6 +244,24 @@ def test_paged_pool_admission_control(setup):
     assert len(out[r1]) == 1 and len(out[r2]) == 1
 
 
+def test_engine_sampling_mode_runs_and_respects_budgets(setup):
+    """temperature > 0: tokens are stochastic (no oracle), but budgets,
+    slot recycling, and vocab bounds must hold."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(10)
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4,
+                                   temperature=0.8,
+                                   rng=jax.random.PRNGKey(42))
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 8, 6)]
+    rids = [eng.submit(p, b) for p, b in zip(prompts, (7, 9, 5))]
+    results = eng.run()
+    for rid, b in zip(rids, (7, 9, 5)):
+        assert len(results[rid]) == b
+        assert (results[rid] >= 0).all()
+        assert (results[rid] < cfg.vocab_size).all()
+
+
 def test_engine_rejects_oversized_request(setup):
     cfg, model, params = setup
     eng = ContinuousBatchingEngine(model, params, n_slots=1)
