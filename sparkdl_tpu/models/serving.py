@@ -46,7 +46,8 @@ def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
 
 @functools.lru_cache(maxsize=64)
 def _engine_programs(dec_cfg, temperature):
-    """(prefill, suffix_prefill, paged_prefill, insert, decode_chunk)
+    """(prefill, suffix_prefill, paged_prefill, insert, decode_chunk,
+    copy_pages)
     — positional order is load-bearing (the engine's _programs[i]
     properties index it) — jitted once per (decode config,
     temperature) — module-level like generate._decode_programs, so a
@@ -105,6 +106,18 @@ def _engine_programs(dec_cfg, temperature):
         last = logits[:, true_len - 1]
         return state["cache"], _sample(last, rng)
 
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def copy_pages(cache, src_pages, dst_pages):
+        """Copy physical pages src->dst inside the pool (paged prefix
+        sharing: the PARTIAL boundary page of a shared prefix must be
+        per-slot — a suffix starting mid-page writes into it)."""
+        def leaf(x):
+            if x.ndim != 4:  # scalar cache_index leaves pass through
+                return x
+            return x.at[dst_pages].set(x[src_pages])
+
+        return jax.tree.map(leaf, cache)
+
     @jax.jit
     def insert(cache, pos, token, one_cache, new_token, p_len, slot):
         # scalar leaves (the shared cache_index, unused on the
@@ -141,7 +154,8 @@ def _engine_programs(dec_cfg, temperature):
         )
         return cache, token, pos, rng, toks  # toks: (n, n_slots)
 
-    return prefill, suffix_prefill, paged_prefill, insert, decode_chunk
+    return (prefill, suffix_prefill, paged_prefill, insert,
+            decode_chunk, copy_pages)
 
 
 @dataclasses.dataclass
@@ -296,6 +310,10 @@ class ContinuousBatchingEngine:
     def _decode_chunk_fn(self):
         return self._programs[4]
 
+    @property
+    def _copy_pages_fn(self):
+        return self._programs[5]
+
     def register_prefix(self, prefix_tokens):
         """Prefill a shared prompt PREFIX (a system prompt) once and
         cache its K/V rows; requests submitted with the returned
@@ -313,10 +331,35 @@ class ContinuousBatchingEngine:
                 f"max_cache_len ({self.cfg.max_cache_len})"
             )
         p_len = len(prefix)
+        self._rng, sub = jax.random.split(self._rng)
+        if self.page_size:
+            # paged sharing: prefill the prefix ONCE into pool pages
+            # that every consumer's block table will reference
+            # read-only (the partial boundary page gets copied per
+            # slot at admission — suffix writes land in it)
+            need = -(-p_len // self.page_size)
+            if need > len(self._free_pages):
+                raise RuntimeError(
+                    f"paged pool exhausted registering prefix: needs "
+                    f"{need} pages, {len(self._free_pages)} free"
+                )
+            pages = [self._free_pages.pop() for _ in range(need)]
+            table = np.zeros((1, self._max_pages), np.int32)
+            table[0, :need] = pages
+            bucket = min(_bucket(p_len), self.cfg.max_cache_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p_len] = prefix
+            self._cache, _tok = self._paged_prefill_fn(
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.asarray(table), sub,
+                jnp.asarray(p_len, jnp.int32), jnp.asarray(0, jnp.int32),
+            )
+            pid = f"prefix-{len(self._prefixes)}"
+            self._prefixes[pid] = (prefix, pages)
+            return pid
         bucket = min(_bucket(p_len), self.cfg.max_cache_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p_len] = prefix
-        self._rng, sub = jax.random.split(self._rng)
         cache, _ = self._prefill_fn(
             self.params, jnp.asarray(padded), sub, p_len
         )
@@ -346,11 +389,6 @@ class ContinuousBatchingEngine:
                 f"({self.cfg.max_cache_len})"
             )
         if prefix_id is not None:
-            if self.page_size:
-                raise ValueError(
-                    "prefix caching is not supported with the paged "
-                    "cache yet (page-table sharing is a next step)"
-                )
             if prefix_id not in self._prefixes:
                 raise ValueError(
                     f"unknown prefix_id {prefix_id!r}; call "
@@ -371,33 +409,73 @@ class ContinuousBatchingEngine:
     def _try_admit_paged(self, slot_idx):
         """Paged admission: allocate the request's worst-case pages
         (whole prompt + budget) from the pool, point the slot's block
-        table at them, prefill straight into the physical pages.
-        Returns False (request left at the queue head) when the pool
-        can't cover it yet — capacity admission control."""
-        rid, prompt, max_new, _ = self._queue[0]
+        table at them, prefill straight into the physical pages. With
+        a prefix_id, the prefix's FULL pages are shared read-only
+        across slots (only the partial boundary page is copied) and
+        only the suffix is prefilled. Returns False (request left at
+        the queue head) when the pool can't cover it yet — capacity
+        admission control."""
+        rid, prompt, max_new, prefix_id = self._queue[0]
+        P = self.page_size
         p_len = len(prompt)
-        need = -(-(p_len + max_new) // self.page_size)
+        total_pages = -(-(p_len + max_new) // P)
+        # no-prefix admission = the empty-prefix special case: zero
+        # shared pages, zero-length start, the whole prompt as suffix
+        prefix = np.zeros((0,), np.int32)
+        prefix_pages = []
+        if prefix_id is not None:
+            prefix, prefix_pages = self._prefixes[prefix_id]
+        n_full = len(prefix) // P
+        shared = prefix_pages[:n_full]
+        need = total_pages - len(shared)
         if need > len(self._free_pages):
             return False
         self._queue.pop(0)
-        pages = [self._free_pages.pop() for _ in range(need)]
-        self._slot_pages[slot_idx] = pages
+        own = [self._free_pages.pop() for _ in range(need)]
+        self._slot_pages[slot_idx] = own
         self._tables[slot_idx] = 0
-        self._tables[slot_idx, :need] = pages
-
-        bucket = min(_bucket(p_len), self.cfg.max_cache_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p_len] = prompt
+        self._tables[slot_idx, :total_pages] = shared + own
         self._rng, sub = jax.random.split(self._rng)
+
+        # copy the partial boundary page (suffix writes land in it);
+        # full shared pages are referenced, never written
+        if len(prefix) % P:
+            self._cache = self._copy_pages_fn(
+                self._cache,
+                jnp.asarray([prefix_pages[n_full]]),
+                jnp.asarray([own[0]]),
+            )
+        suffix = prompt[len(prefix):]
+        start = len(prefix)
+        bucket = min(_bucket(len(suffix)),
+                     self.cfg.max_cache_len - start)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(suffix)] = suffix
         self._cache, tok = self._paged_prefill_fn(
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray(self._tables[slot_idx][None]), sub,
-            jnp.asarray(p_len, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(len(suffix), jnp.int32),
+            jnp.asarray(start, jnp.int32),
         )
+        if len(prefix):
+            self.stats["prefill_tokens_saved"] = (
+                self.stats.get("prefill_tokens_saved", 0) + len(prefix))
         self._pos = self._pos.at[slot_idx].set(p_len)
         self._token = self._token.at[slot_idx].set(tok[0])
         self._activate_slot(slot_idx, rid, max_new, tok)
         return True
+
+    def _pages_needed(self, req):
+        """Fresh pages the queue-head request needs: its worst case
+        minus the prefix pages it would SHARE (run()'s dead-end check
+        must agree with _try_admit_paged or it cries exhaustion over
+        requests that would admit)."""
+        _, prompt, max_new, prefix_id = req
+        total = -(-(len(prompt) + max_new) // self.page_size)
+        if prefix_id is not None:
+            prefix, _ = self._prefixes[prefix_id]
+            total -= len(prefix) // self.page_size
+        return total
 
     def _activate_slot(self, slot_idx, rid, max_new, tok):
         """Shared admission epilogue: slot bookkeeping + the
@@ -466,15 +544,14 @@ class ContinuousBatchingEngine:
             active = np.array([s.active for s in self._slots])
             if not active.any():
                 if self._queue and self.page_size:
-                    need = -(-(len(self._queue[0][1])
-                               + self._queue[0][2]) // self.page_size)
+                    need = self._pages_needed(self._queue[0])
                     # only a GENUINE shortfall is a dead end: an
                     # instantly-finished admission (eos/one-token
                     # budget) also lands here, with pages free again
                     if need > len(self._free_pages):
                         raise RuntimeError(
                             f"paged pool exhausted: request needs "
-                            f"{need} pages, pool has "
+                            f"{need} fresh pages, pool has "
                             f"{len(self._free_pages)} free and nothing "
                             "left to drain — raise n_pages"
                         )
