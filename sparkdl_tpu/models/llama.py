@@ -48,6 +48,11 @@ class LlamaConfig:
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
     quant: str = ""               # "" (dense) | "int8" weight-only serving
                                   # (params from models.quant.quantize_llama_params)
+    # Multi-LoRA serving: > 0 stacks that many adapters on the frozen
+    # base (params from models.lora.stack_lora_adapters); adapter_ids
+    # passed to __call__ select one per batch row (S-LoRA-style
+    # multi-tenant serving). Adapter targets must live in attention.
+    multi_lora: int = 0
     # Sparse-FFN (Mixtral-style) decoder: n_experts > 0 replaces the
     # dense MLP with a top-k routed expert MLP on every moe_every-th
     # layer (1 = all layers). Router-balance aux loss: apply with
@@ -57,6 +62,21 @@ class LlamaConfig:
     moe_every: int = 1
 
     def __post_init__(self):
+        if self.multi_lora:
+            attn_names = {"q_proj", "k_proj", "v_proj", "o_proj"}
+            bad = set(self.lora_targets) - attn_names
+            if bad:
+                raise ValueError(
+                    f"multi_lora supports attention adapter targets "
+                    f"only; got {sorted(bad)}"
+                )
+            if self.quant:
+                raise ValueError(
+                    "multi_lora and quant are mutually exclusive "
+                    "(quantize a merged single-adapter tree instead)"
+                )
+            if not self.lora_rank:
+                raise ValueError("multi_lora requires lora_rank > 0")
         if self.n_experts > 0:
             if not 0 < self.moe_top_k <= self.n_experts:
                 raise ValueError(
@@ -105,6 +125,23 @@ def _dense(cfg, features, name):
                     name=name)
 
 
+def _apply_dense(cfg, features, name, x, adapter_ids=None):
+    """Apply the projection ``name``: per-row multi-adapter LoRA when
+    cfg.multi_lora targets it (ids default to adapter 0 so paths that
+    never select — training, plain generate — still work), else the
+    standard dense/LoRA/quant module from :func:`_dense`."""
+    if cfg.multi_lora and cfg.lora_rank and name in cfg.lora_targets:
+        from sparkdl_tpu.models.lora import MultiLoRADense
+
+        if adapter_ids is None:
+            adapter_ids = jnp.zeros((x.shape[0],), jnp.int32)
+        return MultiLoRADense(
+            features=features, rank=cfg.lora_rank, alpha=cfg.lora_alpha,
+            n_adapters=cfg.multi_lora, dtype=cfg.dtype, name=name,
+        )(x, jnp.asarray(adapter_ids, jnp.int32))
+    return _dense(cfg, features, name)(x)
+
+
 def rope_freqs(head_dim, max_seq, theta):
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                       dtype=jnp.float32) / head_dim))
@@ -142,13 +179,17 @@ class Attention(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, block_tables=None):
+    def __call__(self, x, cos, sin, positions, block_tables=None,
+                 adapter_ids=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.n_heads
         b, s, _ = x.shape
-        q = _dense(cfg, cfg.n_heads * head_dim, "q_proj")(x)
-        k = _dense(cfg, cfg.n_kv_heads * head_dim, "k_proj")(x)
-        v = _dense(cfg, cfg.n_kv_heads * head_dim, "v_proj")(x)
+        q = _apply_dense(cfg, cfg.n_heads * head_dim, "q_proj", x,
+                         adapter_ids)
+        k = _apply_dense(cfg, cfg.n_kv_heads * head_dim, "k_proj", x,
+                         adapter_ids)
+        v = _apply_dense(cfg, cfg.n_kv_heads * head_dim, "v_proj", x,
+                         adapter_ids)
         q = q.reshape(b, s, cfg.n_heads, head_dim)
         k = k.reshape(b, s, cfg.n_kv_heads, head_dim)
         v = v.reshape(b, s, cfg.n_kv_heads, head_dim)
@@ -209,7 +250,7 @@ class Attention(nn.Module):
             o = jnp.einsum(
                 "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
             ).reshape(b, s, cfg.n_heads * head_dim)
-            return _dense(cfg, cfg.d_model, "o_proj")(o)
+            return _apply_dense(cfg, cfg.d_model, "o_proj", o, adapter_ids)
 
         if cfg.decode:
             if s > cfg.max_cache_len:
@@ -281,7 +322,7 @@ class Attention(nn.Module):
             o = jnp.einsum(
                 "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
             ).reshape(b, s, cfg.n_heads * head_dim)
-            return _dense(cfg, cfg.d_model, "o_proj")(o)
+            return _apply_dense(cfg, cfg.d_model, "o_proj", o, adapter_ids)
 
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
@@ -313,7 +354,7 @@ class Attention(nn.Module):
                 q_, k_, v_, causal=True
             )
         o = attend(q, k, v).reshape(b, s, cfg.n_heads * head_dim)
-        return _dense(cfg, cfg.d_model, "o_proj")(o)
+        return _apply_dense(cfg, cfg.d_model, "o_proj", o, adapter_ids)
 
 
 class MLP(nn.Module):
@@ -334,11 +375,12 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, block_tables=None):
+    def __call__(self, x, cos, sin, positions, block_tables=None,
+                 adapter_ids=None):
         cfg = self.cfg
         h = x + Attention(cfg, self.attention_fn, name="attn")(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), cos, sin, positions,
-            block_tables=block_tables,
+            block_tables=block_tables, adapter_ids=adapter_ids,
         )
         if self.use_moe:
             from sparkdl_tpu.models.moe import MoEConfig, MoEMLP
@@ -360,7 +402,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden=False,
-                 block_tables=None):
+                 block_tables=None, adapter_ids=None):
         """``return_hidden=True`` skips the lm_head matmul and returns
         the final-norm hidden states — the input contract of
         :func:`sparkdl_tpu.parallel.train.fused_cross_entropy`, which
@@ -390,7 +432,7 @@ class Llama(nn.Module):
                        and i % cfg.moe_every == cfg.moe_every - 1)
             x = block(cfg, self.attention_fn, use_moe,
                       name=f"layer_{i}")(x, cos, sin, positions,
-                                         block_tables)
+                                         block_tables, adapter_ids)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         if return_hidden:
             return x

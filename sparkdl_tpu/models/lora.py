@@ -40,6 +40,66 @@ class LoRADense(nn.Module):
         return base + (self.alpha / self.rank) * delta
 
 
+class MultiLoRADense(nn.Module):
+    """Serving-side multi-adapter dense: ``n_adapters`` independent
+    low-rank adapters stacked on one frozen base kernel, selected
+    PER BATCH ROW (S-LoRA-style multi-tenant serving — one engine, one
+    base model, many fine-tunes). ``ids``: (batch,) int32 adapter
+    index per row."""
+
+    features: int
+    rank: int = 8
+    alpha: float = 16.0
+    n_adapters: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, ids):
+        d_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (d_in, self.features)
+        ).astype(self.dtype)
+        lora_a = self.param(
+            "lora_a", nn.initializers.normal(stddev=0.02),
+            (self.n_adapters, d_in, self.rank),
+        ).astype(self.dtype)
+        lora_b = self.param(
+            "lora_b", nn.initializers.zeros,
+            (self.n_adapters, self.rank, self.features),
+        ).astype(self.dtype)
+        base = x @ kernel
+        # gather each row's adapter, then two skinny batched matmuls
+        a_sel = lora_a[ids]                       # (b, d_in, r)
+        b_sel = lora_b[ids]                       # (b, r, f)
+        delta = jnp.einsum("bsd,bdr->bsr", x, a_sel)
+        delta = jnp.einsum("bsr,brf->bsf", delta, b_sel)
+        return base + (self.alpha / self.rank) * delta
+
+
+def stack_lora_adapters(param_trees):
+    """Build ONE multi-adapter tree from N single-adapter trees that
+    share a base: every ``lora_a``/``lora_b`` leaf becomes a stacked
+    (N, ...) leaf; base leaves must be IDENTICAL across trees (same
+    frozen model) and are taken from the first."""
+    import numpy as np
+
+    first = param_trees[0]
+
+    def build(path, leaf, *rest):
+        keys = [str(getattr(p, "key", "")) for p in path]
+        if any(k in ("lora_a", "lora_b") for k in keys):
+            return jnp.stack([leaf, *rest])
+        for other in rest:
+            if not np.array_equal(np.asarray(leaf), np.asarray(other)):
+                raise ValueError(
+                    f"base param {'/'.join(keys)} differs across "
+                    "adapter trees — multi-LoRA serves ONE frozen base"
+                )
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(build, first, *param_trees[1:])
+
+
 def lora_mask(params, extra_trainable=()):
     """Bool pytree: True only for lora_a/lora_b leaves (plus any param
     whose path contains one of ``extra_trainable``)."""

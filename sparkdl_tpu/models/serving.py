@@ -65,33 +65,34 @@ def _engine_programs(dec_cfg, temperature):
         ).astype(jnp.int32)
 
     @jax.jit
-    def prefill(params, padded_prompt, rng, true_len):
+    def prefill(params, padded_prompt, rng, true_len, adapter_ids=None):
         # standard shared-index decode-mode prefill, batch 1; junk pad
         # rows land at positions >= true_len where the causal cache
         # mask keeps them invisible until overwritten. true_len is a
         # TRACED scalar: one compile per bucket, not per prompt length.
         logits, state = model.apply(
-            {"params": params}, padded_prompt, mutable=["cache"],
+            {"params": params}, padded_prompt,
+            adapter_ids=adapter_ids, mutable=["cache"],
         )
         last = logits[:, true_len - 1]
         return state["cache"], _sample(last, rng)
 
     @jax.jit
     def suffix_prefill(params, prefix_cache, padded_suffix, rng,
-                       true_len):
+                       true_len, adapter_ids=None):
         # prefix caching: continue a STORED prefix cache (its shared
         # index already sits at the prefix length) over the request's
         # suffix only — the prefix rows are copied, never recomputed
         logits, state = model.apply(
             {"params": params, "cache": prefix_cache}, padded_suffix,
-            mutable=["cache"],
+            adapter_ids=adapter_ids, mutable=["cache"],
         )
         last = logits[:, true_len - 1]
         return state["cache"], _sample(last, rng)
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def paged_prefill(params, cache, padded_prompt, table_row, rng,
-                      true_len, start_pos):
+                      true_len, start_pos, adapter_ids=None):
         """Paged admission: prefill writes STRAIGHT into the pooled
         physical cache through this slot's block table — there is no
         per-slot cache to copy afterwards. ``start_pos`` supports
@@ -101,7 +102,7 @@ def _engine_programs(dec_cfg, temperature):
         logits, state = model.apply(
             {"params": params, "cache": cache}, padded_prompt,
             positions=positions, block_tables=table_row,
-            mutable=["cache"],
+            adapter_ids=adapter_ids, mutable=["cache"],
         )
         last = logits[:, true_len - 1]
         return state["cache"], _sample(last, rng)
@@ -134,13 +135,14 @@ def _engine_programs(dec_cfg, temperature):
     @functools.partial(jax.jit, static_argnums=(6,),
                        donate_argnums=(1,))
     def decode_chunk(params, cache, token, pos, active, rng, n,
-                     tables=None):
+                     tables=None, adapter_ids=None):
         def body(carry, _):
             cache, token, pos, rng = carry
             logits, st = model.apply(
                 {"params": params, "cache": cache},
                 token[:, None], positions=pos[:, None],
-                block_tables=tables, mutable=["cache"],
+                block_tables=tables, adapter_ids=adapter_ids,
+                mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub)
@@ -215,8 +217,10 @@ class ContinuousBatchingEngine:
         from sparkdl_tpu.models.llama import Llama
 
         self._model = Llama(self.cfg)
-        self._queue = []    # (req_id, prompt, max_new, prefix_id)
-        self._prefixes = {}  # prefix_id -> (tokens, prefilled cache)
+        self._queue = []    # (rid, prompt, max_new, prefix_id,
+                            #  adapter_id)
+        self._prefixes = {}  # prefix_id -> (tokens,
+                             #   cache | pool pages, adapter_id)
         self._slots = [_Slot() for _ in range(self.n_slots)]
         self._results = {}
         self._next_id = 0
@@ -242,6 +246,7 @@ class ContinuousBatchingEngine:
         self._cache = state["cache"]
         self._pos = jnp.zeros((self.n_slots,), jnp.int32)
         self._token = jnp.zeros((self.n_slots,), jnp.int32)
+        self._adapter_ids = np.zeros((self.n_slots,), np.int32)
         self.mesh = mesh
         self.params = params
         if mesh is not None:
@@ -314,12 +319,34 @@ class ContinuousBatchingEngine:
     def _copy_pages_fn(self):
         return self._programs[5]
 
-    def register_prefix(self, prefix_tokens):
+
+    def _adapter_arg(self, adapter_id):
+        """adapter_ids argument for a batch-1 program call — None on
+        single-adapter engines (keeps program signatures identical)."""
+        if not self.cfg.multi_lora:
+            return None
+        return jnp.asarray([adapter_id], jnp.int32)
+
+    def register_prefix(self, prefix_tokens, adapter_id=0):
         """Prefill a shared prompt PREFIX (a system prompt) once and
         cache its K/V rows; requests submitted with the returned
         ``prefix_id`` prefill only their suffix — admission cost drops
         from O(full prompt) to O(suffix) compute plus a device-side
-        row copy."""
+        row copy. The cached rows are ADAPTER-SPECIFIC when the engine
+        serves multi-LoRA (k/v projections carry the adapter), so a
+        prefix is bound to ``adapter_id`` and only same-adapter
+        requests may use it."""
+        if self.cfg.multi_lora:
+            if not 0 <= adapter_id < self.cfg.multi_lora:
+                raise ValueError(
+                    f"adapter_id {adapter_id} outside the stacked "
+                    f"range [0, {self.cfg.multi_lora})"
+                )
+        elif adapter_id:
+            raise ValueError(
+                "adapter_id requires a multi_lora model "
+                "(LlamaConfig.multi_lora > 0)"
+            )
         prefix = np.asarray(prefix_tokens, np.int32).reshape(-1)
         if not len(prefix):
             raise ValueError("empty prefix")
@@ -353,15 +380,17 @@ class ContinuousBatchingEngine:
                 self.params, self._cache, jnp.asarray(padded),
                 jnp.asarray(table), sub,
                 jnp.asarray(p_len, jnp.int32), jnp.asarray(0, jnp.int32),
+                adapter_ids=self._adapter_arg(adapter_id),
             )
             pid = f"prefix-{len(self._prefixes)}"
-            self._prefixes[pid] = (prefix, pages)
+            self._prefixes[pid] = (prefix, pages, adapter_id)
             return pid
         bucket = min(_bucket(p_len), self.cfg.max_cache_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p_len] = prefix
         cache, _ = self._prefill_fn(
-            self.params, jnp.asarray(padded), sub, p_len
+            self.params, jnp.asarray(padded), sub, p_len,
+            adapter_ids=self._adapter_arg(adapter_id),
         )
         # pin the shared index to the TRUE length (the bucket-padded
         # prefill advanced it to the bucket; junk rows beyond p_len
@@ -370,14 +399,28 @@ class ContinuousBatchingEngine:
             lambda x: jnp.full(x.shape, p_len, x.dtype)
             if x.ndim == 0 else x, cache)
         pid = f"prefix-{len(self._prefixes)}"
-        self._prefixes[pid] = (prefix, cache)
+        self._prefixes[pid] = (prefix, cache, adapter_id)
         return pid
 
-    def submit(self, prompt_tokens, max_new_tokens, prefix_id=None):
+    def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
+               adapter_id=0):
         """Queue a request; returns its id. ``prefix_id`` (from
         :meth:`register_prefix`): the prompt must START with that
-        prefix and extend it by at least one token."""
+        prefix and extend it by at least one token. ``adapter_id``
+        selects this request's LoRA adapter when the engine serves a
+        multi-adapter tree (cfg.multi_lora)."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if self.cfg.multi_lora:
+            if not 0 <= adapter_id < self.cfg.multi_lora:
+                raise ValueError(
+                    f"adapter_id {adapter_id} outside the stacked "
+                    f"range [0, {self.cfg.multi_lora})"
+                )
+        elif adapter_id:
+            raise ValueError(
+                "adapter_id requires a multi_lora model "
+                "(LlamaConfig.multi_lora > 0)"
+            )
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -394,7 +437,13 @@ class ContinuousBatchingEngine:
                     f"unknown prefix_id {prefix_id!r}; call "
                     "register_prefix first"
                 )
-            prefix, _ = self._prefixes[prefix_id]
+            prefix, _, pfx_adapter = self._prefixes[prefix_id]
+            if self.cfg.multi_lora and pfx_adapter != adapter_id:
+                raise ValueError(
+                    f"prefix {prefix_id} is bound to adapter "
+                    f"{pfx_adapter}; request uses {adapter_id} — "
+                    "cached K/V rows are adapter-specific"
+                )
             if (len(prompt) <= len(prefix)
                     or not np.array_equal(prompt[:len(prefix)], prefix)):
                 raise ValueError(
@@ -403,7 +452,9 @@ class ContinuousBatchingEngine:
                 )
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, prompt, int(max_new_tokens), prefix_id))
+        self._queue.append(
+            (rid, prompt, int(max_new_tokens), prefix_id,
+             int(adapter_id)))
         return rid
 
     def _try_admit_paged(self, slot_idx):
@@ -415,7 +466,7 @@ class ContinuousBatchingEngine:
         only the suffix is prefilled. Returns False (request left at
         the queue head) when the pool can't cover it yet — capacity
         admission control."""
-        rid, prompt, max_new, prefix_id = self._queue[0]
+        rid, prompt, max_new, prefix_id, adapter_id = self._queue[0]
         P = self.page_size
         p_len = len(prompt)
         total_pages = -(-(p_len + max_new) // P)
@@ -424,7 +475,7 @@ class ContinuousBatchingEngine:
         prefix = np.zeros((0,), np.int32)
         prefix_pages = []
         if prefix_id is not None:
-            prefix, prefix_pages = self._prefixes[prefix_id]
+            prefix, prefix_pages, _pfx_adapter = self._prefixes[prefix_id]
         n_full = len(prefix) // P
         shared = prefix_pages[:n_full]
         need = total_pages - len(shared)
@@ -456,12 +507,14 @@ class ContinuousBatchingEngine:
             jnp.asarray(self._tables[slot_idx][None]), sub,
             jnp.asarray(len(suffix), jnp.int32),
             jnp.asarray(start, jnp.int32),
+            adapter_ids=self._adapter_arg(adapter_id),
         )
         if len(prefix):
             self.stats["prefill_tokens_saved"] = (
                 self.stats.get("prefill_tokens_saved", 0) + len(prefix))
         self._pos = self._pos.at[slot_idx].set(p_len)
         self._token = self._token.at[slot_idx].set(tok[0])
+        self._adapter_ids[slot_idx] = adapter_id
         self._activate_slot(slot_idx, rid, max_new, tok)
         return True
 
@@ -470,10 +523,10 @@ class ContinuousBatchingEngine:
         minus the prefix pages it would SHARE (run()'s dead-end check
         must agree with _try_admit_paged or it cries exhaustion over
         requests that would admit)."""
-        _, prompt, max_new, prefix_id = req
+        _, prompt, max_new, prefix_id, _aid = req
         total = -(-(len(prompt) + max_new) // self.page_size)
         if prefix_id is not None:
-            prefix, _ = self._prefixes[prefix_id]
+            prefix, _, _pfx = self._prefixes[prefix_id]
             total -= len(prefix) // self.page_size
         return total
 
@@ -490,11 +543,11 @@ class ContinuousBatchingEngine:
             self._finish(slot_idx)
 
     def _admit(self, slot_idx):
-        rid, prompt, max_new, prefix_id = self._queue.pop(0)
+        rid, prompt, max_new, prefix_id, adapter_id = self._queue.pop(0)
         p_len = len(prompt)
         self._rng, sub = jax.random.split(self._rng)
         if prefix_id is not None:
-            prefix, prefix_cache = self._prefixes[prefix_id]
+            prefix, prefix_cache, _pfx_adapter = self._prefixes[prefix_id]
             suffix = prompt[len(prefix):]
             bucket = min(_bucket(len(suffix)),
                          self.cfg.max_cache_len - len(prefix))
@@ -503,6 +556,7 @@ class ContinuousBatchingEngine:
             one_cache, tok = self._suffix_prefill_fn(
                 self.params, prefix_cache, jnp.asarray(padded), sub,
                 len(suffix),
+                adapter_ids=self._adapter_arg(adapter_id),
             )
             self.stats["prefill_tokens_saved"] = (
                 self.stats.get("prefill_tokens_saved", 0) + len(prefix))
@@ -511,12 +565,14 @@ class ContinuousBatchingEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p_len] = prompt
             one_cache, tok = self._prefill_fn(
-                self.params, jnp.asarray(padded), sub, p_len
+                self.params, jnp.asarray(padded), sub, p_len,
+                adapter_ids=self._adapter_arg(adapter_id),
             )
         self._cache, self._pos, self._token = self._insert_fn(
             self._cache, self._pos, self._token, one_cache, tok,
             p_len, slot_idx,
         )
+        self._adapter_ids[slot_idx] = adapter_id
         self._activate_slot(slot_idx, rid, max_new, tok)
 
     def _finish(self, slot_idx):
@@ -574,6 +630,8 @@ class ContinuousBatchingEngine:
                 jnp.asarray(active), self._rng, n,
                 tables=(jnp.asarray(self._tables)
                         if self.page_size else None),
+                adapter_ids=(jnp.asarray(self._adapter_ids)
+                             if self.cfg.multi_lora else None),
             )
             toks = np.asarray(toks)                 # (n, n_slots)
             self.stats["steps"] += n

@@ -41,6 +41,13 @@ def param_sharding(params, rules, mesh):
         if spec is None:
             return P()
         spec = P(*spec) if not isinstance(spec, P) else spec
+        if (len(spec) < leaf.ndim
+                and re.search(r"lora_(a|b)$", path)):
+            # Stacked multi-adapter leaves (n_adapters, ...) reuse the
+            # 2-D adapter rules: LEFT-pad so the trailing (in/out)
+            # dims keep their Megatron split — without this, lora_b's
+            # (None, 'model') would shard the RANK dim of a 3-D leaf.
+            spec = P(*([None] * (leaf.ndim - len(spec)) + list(spec)))
         # validate divisibility
         for dim, names in enumerate(spec):
             if names is None:

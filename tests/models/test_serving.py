@@ -282,6 +282,71 @@ def test_paged_prefix_sharing_is_exact(setup, prefix_len):
     assert per_req >= 1  # sanity: the accounting above meant something
 
 
+@pytest.mark.parametrize("page_size", [0, 8])
+def test_multi_lora_serving_matches_per_adapter_engines(setup,
+                                                        page_size):
+    """S-LoRA-style multi-tenant serving: one engine, one frozen base,
+    N adapters selected per request — every request's tokens must
+    equal a single-adapter engine running its adapter's tree."""
+    import dataclasses
+
+    from sparkdl_tpu.models.lora import stack_lora_adapters
+
+    cfg0 = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96,
+                            lora_rank=4)
+    single = Llama(cfg0)
+    rng = np.random.default_rng(12)
+    seedp = jnp.asarray(rng.integers(0, cfg0.vocab_size, (1, 8)),
+                        jnp.int32)
+    tree0 = single.init(jax.random.PRNGKey(0), seedp)["params"]
+
+    def with_new_adapters(tree, seed):
+        k = jax.random.PRNGKey(seed)
+
+        def leaf(path, x):
+            name = str(getattr(path[-1], "key", ""))
+            if name in ("lora_a", "lora_b"):
+                nonlocal k
+                k, sub = jax.random.split(k)
+                return jax.random.normal(sub, x.shape, x.dtype) * 0.05
+            return x
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    tree1 = with_new_adapters(tree0, 1)
+    trees = [tree0, tree1]
+    multi_params = stack_lora_adapters(trees)
+    cfg_m = dataclasses.replace(cfg0, multi_lora=2)
+    multi = Llama(cfg_m)
+
+    prompts = [rng.integers(0, cfg0.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 7, 6)]
+    adapters = [0, 1, 1]
+
+    eng = ContinuousBatchingEngine(multi, multi_params, n_slots=2,
+                                   chunk=4, page_size=page_size)
+    rids = [eng.submit(p, 8, adapter_id=a)
+            for p, a in zip(prompts, adapters)]
+    out = eng.run()
+
+    for p, a, rid in zip(prompts, adapters, rids):
+        solo = ContinuousBatchingEngine(single, trees[a], n_slots=1,
+                                        chunk=4)
+        r = solo.submit(p, 8)
+        ref = solo.run()[r]
+        np.testing.assert_array_equal(
+            out[rid], ref,
+            err_msg=f"adapter {a} diverged from its own tree",
+        )
+
+    # adapter binding contract
+    with pytest.raises(ValueError, match="outside the stacked range"):
+        eng.submit(prompts[0], 4, adapter_id=5)
+    single_eng = ContinuousBatchingEngine(single, tree0, n_slots=1)
+    with pytest.raises(ValueError, match="requires a multi_lora"):
+        single_eng.submit(prompts[0], 4, adapter_id=1)
+
+
 def test_engine_sampling_mode_runs_and_respects_budgets(setup):
     """temperature > 0: tokens are stochastic (no oracle), but budgets,
     slot recycling, and vocab bounds must hold."""

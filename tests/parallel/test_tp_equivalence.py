@@ -84,3 +84,32 @@ def test_sharded_checkpoint_restore(mesh, tmp_path):
         )
     finally:
         ckpt.close()
+
+
+def test_stacked_multi_lora_adapters_keep_megatron_split():
+    """Stacked (n_adapters, ...) LoRA leaves reuse the 2-D adapter
+    rules RIGHT-aligned: lora_b's 'model' split stays on the features
+    dim — on a 3-D leaf the naive rule would shard the rank dim."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import Llama, LlamaConfig
+    from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+    from sparkdl_tpu.parallel.sharding import (
+        TRANSFORMER_RULES,
+        param_sharding,
+    )
+
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = LlamaConfig.tiny(lora_rank=4, multi_lora=2)
+    p = Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sh = param_sharding(p, TRANSFORMER_RULES, mesh)
+    lb = sh["layer_0"]["attn"]["q_proj"]["lora_b"]
+    assert lb.spec == jax.sharding.PartitionSpec(None, None, "model")
+    la = sh["layer_0"]["attn"]["q_proj"]["lora_a"]
+    assert la.spec == jax.sharding.PartitionSpec(None, None, None)
