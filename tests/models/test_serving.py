@@ -282,6 +282,23 @@ def test_paged_prefix_sharing_is_exact(setup, prefix_len):
     assert per_req >= 1  # sanity: the accounting above meant something
 
 
+
+
+def _with_new_adapters(tree, seed):
+    """Replace lora_a/lora_b leaves with fresh random values (a second
+    'fine-tune' sharing the same frozen base)."""
+    k = jax.random.PRNGKey(seed)
+
+    def leaf(path, x):
+        name = str(getattr(path[-1], "key", ""))
+        if name in ("lora_a", "lora_b"):
+            nonlocal k
+            k, sub = jax.random.split(k)
+            return jax.random.normal(sub, x.shape, x.dtype) * 0.05
+        return x
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
 @pytest.mark.parametrize("page_size", [0, 8])
 def test_multi_lora_serving_matches_per_adapter_engines(setup,
                                                         page_size):
@@ -300,20 +317,7 @@ def test_multi_lora_serving_matches_per_adapter_engines(setup,
                         jnp.int32)
     tree0 = single.init(jax.random.PRNGKey(0), seedp)["params"]
 
-    def with_new_adapters(tree, seed):
-        k = jax.random.PRNGKey(seed)
-
-        def leaf(path, x):
-            name = str(getattr(path[-1], "key", ""))
-            if name in ("lora_a", "lora_b"):
-                nonlocal k
-                k, sub = jax.random.split(k)
-                return jax.random.normal(sub, x.shape, x.dtype) * 0.05
-            return x
-
-        return jax.tree_util.tree_map_with_path(leaf, tree)
-
-    tree1 = with_new_adapters(tree0, 1)
+    tree1 = _with_new_adapters(tree0, 1)
     trees = [tree0, tree1]
     multi_params = stack_lora_adapters(trees)
     cfg_m = dataclasses.replace(cfg0, multi_lora=2)
@@ -345,6 +349,57 @@ def test_multi_lora_serving_matches_per_adapter_engines(setup,
     single_eng = ContinuousBatchingEngine(single, tree0, n_slots=1)
     with pytest.raises(ValueError, match="requires a multi_lora"):
         single_eng.submit(prompts[0], 4, adapter_id=1)
+
+
+def test_paged_prefix_multi_lora_compose(setup):
+    """The serving features COMPOSE: paged pool + adapter-bound shared
+    prefix + per-request adapters in one engine, tokens still equal
+    each adapter's own single-feature engine."""
+    import dataclasses
+
+    from sparkdl_tpu.models.lora import stack_lora_adapters
+
+    cfg0 = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96,
+                            lora_rank=4)
+    single = Llama(cfg0)
+    rng = np.random.default_rng(13)
+    seedp = jnp.asarray(rng.integers(0, cfg0.vocab_size, (1, 8)),
+                        jnp.int32)
+    tree0 = single.init(jax.random.PRNGKey(0), seedp)["params"]
+    tree1 = _with_new_adapters(tree0, 7)
+    trees = [tree0, tree1]
+    multi_params = stack_lora_adapters(trees)
+    multi = Llama(dataclasses.replace(cfg0, multi_lora=2))
+
+    system = rng.integers(0, cfg0.vocab_size, (11,)).astype(np.int32)
+    suffixes = [rng.integers(0, cfg0.vocab_size, (n,)).astype(np.int32)
+                for n in (4, 6)]
+    prompts = [np.concatenate([system, s]) for s in suffixes]
+    adapters = [1, 1]  # the prefix is bound to adapter 1
+
+    eng = ContinuousBatchingEngine(multi, multi_params, n_slots=2,
+                                   chunk=4, page_size=8)
+    pid = eng.register_prefix(system, adapter_id=1)
+    rids = [eng.submit(p, 8, prefix_id=pid, adapter_id=a)
+            for p, a in zip(prompts, adapters)]
+    # heterogeneous batch: a plain adapter-0 request runs ALONGSIDE
+    # the prefix-bound adapter-1 streams — a bug smearing the
+    # prefix's adapter over other slots would corrupt it
+    plain = rng.integers(0, cfg0.vocab_size, (6,)).astype(np.int32)
+    prompts = prompts + [plain]
+    adapters = adapters + [0]
+    rids.append(eng.submit(plain, 8, adapter_id=0))
+    out = eng.run()
+
+    for p, a, rid in zip(prompts, adapters, rids):
+        solo = ContinuousBatchingEngine(single, trees[a], n_slots=1,
+                                        chunk=4)
+        r = solo.submit(p, 8)
+        np.testing.assert_array_equal(out[rid], solo.run()[r])
+
+    # wrong-adapter use of the bound prefix is refused
+    with pytest.raises(ValueError, match="bound to adapter"):
+        eng.submit(prompts[0], 4, prefix_id=pid, adapter_id=0)
 
 
 def test_engine_sampling_mode_runs_and_respects_budgets(setup):
