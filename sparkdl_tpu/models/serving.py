@@ -183,7 +183,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, params, *, n_slots=4, temperature=0.0,
                  eos_id=None, chunk=16, rng=None, mesh=None,
-                 rules=None, page_size=0, n_pages=None):
+                 rules=None, page_size=0, n_pages=None,
+                 prefill_chunk=0):
         """``mesh`` enables tensor-parallel serving: params are placed
         per ``rules`` (default TRANSFORMER_RULES — Megatron column/row
         splits) and the KV cache is sharded over its kv-heads axis on
@@ -198,9 +199,26 @@ class ContinuousBatchingEngine:
         queues the request when the pool is exhausted (capacity
         admission control); a finished request's pages return to the
         pool. Page 0 is a write-only dump for bucket-padding junk.
-        Default ``n_pages`` reproduces dense capacity exactly."""
+        Default ``n_pages`` reproduces dense capacity exactly.
+
+        ``prefill_chunk`` (paged only): prompts longer than this
+        prefill in segments interleaved with decode chunks
+        (Sarathi-style), bounding the decode stall a long admission
+        causes to one segment instead of the whole prompt."""
         cfg = model.cfg
         self.page_size = int(page_size)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}"
+            )
+        if self.prefill_chunk and not self.page_size:
+            raise ValueError(
+                "prefill_chunk requires the paged cache (page_size>0): "
+                "the dense slot cache has no per-slot write path for "
+                "partial prompts"
+            )
+        self._prefilling = {}  # slot -> staged chunked-prefill state
         self._max_pages = (
             -(-cfg.max_cache_len // self.page_size) if page_size else 0)
         if page_size:
@@ -486,7 +504,6 @@ class ContinuousBatchingEngine:
         self._slot_pages[slot_idx] = own
         self._tables[slot_idx] = 0
         self._tables[slot_idx, :total_pages] = shared + own
-        self._rng, sub = jax.random.split(self._rng)
 
         # copy the partial boundary page (suffix writes land in it);
         # full shared pages are referenced, never written
@@ -498,25 +515,75 @@ class ContinuousBatchingEngine:
             )
         suffix = prompt[len(prefix):]
         start = len(prefix)
-        bucket = min(_bucket(len(suffix)),
-                     self.cfg.max_cache_len - start)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(suffix)] = suffix
-        self._cache, tok = self._paged_prefill_fn(
-            self.params, self._cache, jnp.asarray(padded),
-            jnp.asarray(self._tables[slot_idx][None]), sub,
-            jnp.asarray(len(suffix), jnp.int32),
-            jnp.asarray(start, jnp.int32),
-            adapter_ids=self._adapter_arg(adapter_id),
-        )
         if len(prefix):
             self.stats["prefill_tokens_saved"] = (
                 self.stats.get("prefill_tokens_saved", 0) + len(prefix))
-        self._pos = self._pos.at[slot_idx].set(p_len)
-        self._token = self._token.at[slot_idx].set(tok[0])
-        self._adapter_ids[slot_idx] = adapter_id
-        self._activate_slot(slot_idx, rid, max_new, tok)
+        if self.prefill_chunk and len(suffix) > self.prefill_chunk:
+            # Chunked prefill: this admission only STAGES the slot —
+            # segments run one per engine-loop iteration, interleaved
+            # with decode chunks, so a long prompt can't stall running
+            # streams for its whole length. The slot stays inactive
+            # (masked out of decode tables) until the final segment.
+            self._prefilling[slot_idx] = {
+                "rid": rid, "suffix": suffix, "start": start,
+                "done": 0, "max_new": max_new,
+                "adapter_id": adapter_id,
+            }
+            # first segment runs in the run-loop's advance phase — a
+            # staging-time segment would make admission a TWO-segment
+            # decode stall, breaking the one-per-iteration bound
+            return True
+        self._prefill_segment(slot_idx, suffix, start, len(suffix),
+                              adapter_id, final=True,
+                              rid=rid, max_new=max_new)
         return True
+
+    def _prefill_segment(self, slot_idx, seg_tokens, start, true_len,
+                         adapter_id, *, final, rid=None, max_new=None):
+        """Run one paged prefill program over ``seg_tokens`` at logical
+        offset ``start``. On the FINAL segment the sampled token (the
+        request's first generated token) activates the slot."""
+        self._rng, sub = jax.random.split(self._rng)
+        # power-of-two pad with a floor of 8 (the global _bucket floor
+        # of 32 would multiply the compute of small prefill_chunk
+        # segments); the cache-end cap can't undercut true_len because
+        # submit() bounds every position below max_cache_len
+        b = 8
+        while b < true_len:
+            b *= 2
+        bucket = min(b, self.cfg.max_cache_len - start)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :true_len] = seg_tokens[:true_len]
+        self._cache, tok = self._paged_prefill_fn(
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray(self._tables[slot_idx][None]), sub,
+            jnp.asarray(true_len, jnp.int32),
+            jnp.asarray(start, jnp.int32),
+            adapter_ids=self._adapter_arg(adapter_id),
+        )
+        self.stats["prefill_segments"] = (
+            self.stats.get("prefill_segments", 0) + 1)
+        if final:
+            p_len = start + true_len
+            self._pos = self._pos.at[slot_idx].set(p_len)
+            self._token = self._token.at[slot_idx].set(tok[0])
+            self._adapter_ids[slot_idx] = adapter_id
+            self._activate_slot(slot_idx, rid, max_new, tok)
+
+    def _advance_prefill(self, slot_idx):
+        """One more segment for a mid-prefill slot; activates it on
+        the last one."""
+        st = self._prefilling[slot_idx]
+        seg = min(self.prefill_chunk, len(st["suffix"]) - st["done"])
+        final = st["done"] + seg == len(st["suffix"])
+        self._prefill_segment(
+            slot_idx, st["suffix"][st["done"]:st["done"] + seg],
+            st["start"] + st["done"], seg, st["adapter_id"],
+            final=final, rid=st["rid"], max_new=st["max_new"],
+        )
+        st["done"] += seg
+        if final:
+            del self._prefilling[slot_idx]
 
     def _pages_needed(self, req):
         """Fresh pages the queue-head request needs: its worst case
@@ -587,19 +654,27 @@ class ContinuousBatchingEngine:
 
     def run(self, progress=None):
         """Drain the queue; returns {req_id: generated tokens}."""
-        while self._queue or any(s.active for s in self._slots):
+        while (self._queue or self._prefilling
+               or any(s.active for s in self._slots)):
             # fill free slots from the queue (paged: only while the
             # pool covers the next request's worst case)
             for i, s in enumerate(self._slots):
-                if not s.active and self._queue:
+                if (not s.active and i not in self._prefilling
+                        and self._queue):
                     if self.page_size:
                         if not self._try_admit_paged(i):
                             break
                     else:
                         self._admit(i)
+            # one prefill segment per staged slot per iteration:
+            # long-prompt admission interleaves with decode instead of
+            # stalling it for the whole prompt
+            for i in list(self._prefilling):
+                self._advance_prefill(i)
             active = np.array([s.active for s in self._slots])
             if not active.any():
-                if self._queue and self.page_size:
+                if self._queue and self.page_size \
+                        and not self._prefilling:
                     need = self._pages_needed(self._queue[0])
                     # only a GENUINE shortfall is a dead end: an
                     # instantly-finished admission (eos/one-token
@@ -628,7 +703,11 @@ class ContinuousBatchingEngine:
              toks) = self._decode_chunk_fn(
                 self.params, self._cache, self._token, self._pos,
                 jnp.asarray(active), self._rng, n,
-                tables=(jnp.asarray(self._tables)
+                # non-active rows masked to the dump page: a
+                # mid-prefill slot's junk writes must not corrupt the
+                # rows it has already prefilled
+                tables=(jnp.asarray(
+                    np.where(active[:, None], self._tables, 0))
                         if self.page_size else None),
                 adapter_ids=(jnp.asarray(self._adapter_ids)
                              if self.cfg.multi_lora else None),
