@@ -219,6 +219,7 @@ class ContinuousBatchingEngine:
                 "partial prompts"
             )
         self._prefilling = {}  # slot -> staged chunked-prefill state
+        self._on_token = None  # streaming callback, set per run()
         self._max_pages = (
             -(-cfg.max_cache_len // self.page_size) if page_size else 0)
         if page_size:
@@ -605,6 +606,8 @@ class ContinuousBatchingEngine:
         s.req_id, s.active = rid, True
         s.remaining = max_new - 1  # the prefill emitted token #1
         s.tokens = [int(np.asarray(tok)[0])]
+        if self._on_token is not None:
+            self._on_token(rid, s.tokens[0])
         if (self.eos_id is not None and s.tokens[0] == self.eos_id) \
                 or s.remaining == 0:
             self._finish(slot_idx)
@@ -652,8 +655,23 @@ class ContinuousBatchingEngine:
             self._slot_pages[slot_idx] = []
             self._tables[slot_idx] = 0
 
-    def run(self, progress=None):
-        """Drain the queue; returns {req_id: generated tokens}."""
+    def run(self, progress=None, on_token=None):
+        """Drain the queue; returns {req_id: generated tokens}.
+
+        ``on_token(req_id, token)``: streaming callback invoked for
+        every accepted token in generation order (a serving front-end
+        pushes these to clients; delivery granularity is the decode
+        chunk — the XLA-first trade-off documented on the class).
+        ``progress(engine)``: coarse per-iteration hook."""
+        self._on_token = on_token
+        try:
+            return self._run(progress)
+        finally:
+            # never retain the caller's closure (and whatever client
+            # buffers/connections it holds) past this run
+            self._on_token = None
+
+    def _run(self, progress):
         while (self._queue or self._prefilling
                or any(s.active for s in self._slots)):
             # fill free slots from the queue (paged: only while the
@@ -722,6 +740,8 @@ class ContinuousBatchingEngine:
                 for t in toks[:, i]:
                     s.tokens.append(int(t))
                     s.remaining -= 1
+                    if self._on_token is not None:
+                        self._on_token(s.req_id, int(t))
                     if ((self.eos_id is not None and int(t) == self.eos_id)
                             or s.remaining == 0):
                         self._finish(i)

@@ -470,6 +470,24 @@ def test_engine_sampling_mode_runs_and_respects_budgets(setup):
         assert (results[rid] < cfg.vocab_size).all()
 
 
+def test_on_token_streams_every_token_in_order(setup):
+    """The streaming callback delivers every accepted token — prefill
+    first tokens included — in generation order per request, matching
+    the final results exactly."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 7, 6)]
+    budgets = [6, 9, 4]
+    streamed = {}
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4)
+    rids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+    results = eng.run(
+        on_token=lambda rid, t: streamed.setdefault(rid, []).append(t))
+    for rid in rids:
+        np.testing.assert_array_equal(results[rid], streamed[rid])
+
+
 def test_engine_rejects_oversized_request(setup):
     cfg, model, params = setup
     eng = ContinuousBatchingEngine(model, params, n_slots=1)
