@@ -11,6 +11,9 @@ test-fast:          # skip multiprocess gang tests (each worker imports jax/tf)
 bench:              # single-chip headline bench (run on a TPU host)
 	python bench.py
 
+bench-all:          # every TPU artifact in one lease session
+	bash benchmarks/tpu_homecoming.sh
+
 native:             # build the C++ control-plane transport
 	$(MAKE) -C native
 
