@@ -244,9 +244,9 @@ def run():
     )
 
     promoted = _promoted_config()
-    if promoted.get("flash_block"):
-        os.environ["SPARKDL_TPU_FLASH_BLOCK"] = str(
-            promoted["flash_block"])
+    # flash_block rides LlamaConfig (part of the jit cache key), not
+    # the env var (read once at attention-module import).
+    flash_block = int(promoted.get("flash_block", 0))
     attention = promoted.get("attention", "reference")
     if os.environ.get("SPARKDL_TPU_BENCH_TINY"):
         # CI smoke config: exercises the full measurement path in
@@ -254,14 +254,14 @@ def run():
         cfg = LlamaConfig(
             vocab_size=512, d_model=128, n_layers=2, n_heads=4,
             n_kv_heads=2, d_ff=256, dtype=jnp.bfloat16, lora_rank=4,
-            attention=attention,
+            attention=attention, flash_block=flash_block,
         )
         batch, seq = 2, 128
     else:
         cfg = LlamaConfig(
             vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
             n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16, lora_rank=16,
-            attention=attention,
+            attention=attention, flash_block=flash_block,
         )
         batch, seq = 8, 1024
     model = Llama(cfg)

@@ -22,7 +22,10 @@ import numpy as np
 
 
 def measure(attention, batch, seq, remat=False, n_steps=20,
-            loss="logits", chunk=512, ce_bf16=False):
+            loss="logits", chunk=512, ce_bf16=False, flash_block=128):
+    # flash_block defaults to the LIBRARY default explicitly (not 0 =
+    # "whatever SPARKDL_TPU_FLASH_BLOCK says"): an ambient env var
+    # must not silently retune the unlabeled baseline variants.
     import jax
     import jax.numpy as jnp
     import optax
@@ -36,7 +39,7 @@ def measure(attention, batch, seq, remat=False, n_steps=20,
     cfg = LlamaConfig(
         vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
         n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16, lora_rank=16,
-        attention=attention,
+        attention=attention, flash_block=flash_block,
     )
     model = Llama(cfg)
     tokens = np.zeros((batch, seq), np.int32)
@@ -111,12 +114,11 @@ def main():
              "remat": True},
         ]
     for v in variants:
-        block = v.pop("flash_block", None)
-        if block is not None:
-            os.environ["SPARKDL_TPU_FLASH_BLOCK"] = str(block)
-        else:
-            os.environ.pop("SPARKDL_TPU_FLASH_BLOCK", None)
-        label = dict(v, **({"flash_block": block} if block else {}))
+        # flash_block rides the model config (NOT an env var): the env
+        # is read at import, and several variants share shapes — a
+        # per-variant env write would be silently ignored by the jit
+        # cache and misattribute the tile sweep.
+        label = dict(v)
         try:
             tps = measure(**v)
             print(json.dumps({**label, "tokens_per_sec": round(tps, 1)}),

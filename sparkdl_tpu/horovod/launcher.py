@@ -634,9 +634,22 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 coordinator = (
                     f"{server.address.rsplit(':', 1)[0]}:{_free_port()}")
             else:
-                # Can't probe a free port on a remote machine; use a
-                # fixed well-known port there (override via env).
-                port = os.environ.get(COORD_PORT_ENV, "8998")
+                # Can't probe a free port on a remote machine. A FIXED
+                # well-known port would collide the moment two gangs'
+                # rank 0 land on the same host, so derive the default
+                # from this job's unique job_dir — stable for the gang
+                # (every rank computes the rendezvous from the same
+                # coordinator string), near-unique across jobs.
+                # Operators pin it via env when a firewall needs one
+                # known port.
+                port = os.environ.get(COORD_PORT_ENV)
+                if not port:
+                    import hashlib
+
+                    digest = hashlib.sha256(
+                        job_dir.encode()).digest()
+                    port = str(49152 + int.from_bytes(
+                        digest[:2], "big") % 16384)
                 coordinator = f"{host0}:{port}"
 
         logger.info(

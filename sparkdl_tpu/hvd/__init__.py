@@ -193,11 +193,23 @@ def broadcast_object(obj, root_rank=0, name=None):
         return obj
     if rank() == root_rank:
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-        n = np.array([payload.shape[0]], np.int64)
+        n = np.array([payload.shape[0]], np.float64)
     else:
         payload = None
-        n = np.zeros((1,), np.int64)
+        n = np.zeros((1,), np.float64)
+    # Size rides float64 (exact to 2**53): the collective engine
+    # canonicalizes ints to int32 when x64 is off, which would wrap a
+    # >2 GiB size negative. The payload broadcast itself is still
+    # int32-bounded, so oversize fails loudly — AFTER the exchange, so
+    # every rank raises together instead of the big rank bailing
+    # pre-collective and wedging the others mid-broadcast.
     n = engine().broadcast(n, root_rank)
+    if int(n[0]) >= 2**31:
+        raise ValueError(
+            f"broadcast_object payload is {int(n[0])} bytes; the "
+            "payload broadcast is int32-bounded (< 2 GiB pickled). "
+            "Broadcast a reference (path/handle) instead."
+        )
     if payload is None:
         payload = np.zeros((int(n[0]),), np.uint8)
     payload = engine().broadcast(payload, root_rank)
@@ -213,7 +225,20 @@ def allgather_object(obj, name=None):
     if size() == 1:
         return [obj]
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-    sizes = engine().allgather(np.array([[payload.shape[0]]], np.int64))
+    # Sizes ride float64 (exact to 2**53; int32 canonicalization would
+    # wrap >2 GiB negative and corrupt every unpack offset). The guard
+    # fires AFTER the size exchange so every rank raises the same
+    # error together — a lone oversized rank bailing pre-collective
+    # would leave the rest of the gang wedged in the allgather.
+    sizes = engine().allgather(
+        np.array([[payload.shape[0]]], np.float64))
+    if sizes.max() >= 2**31:
+        raise ValueError(
+            f"allgather_object payload of {int(sizes.max())} bytes on "
+            f"rank {int(sizes[:, 0].argmax())}: the payload gather is "
+            "int32-bounded (< 2 GiB pickled). Gather a reference "
+            "(path/handle) instead of the object."
+        )
     flat = engine().allgather(payload)
     out, off = [], 0
     for n in sizes[:, 0]:

@@ -34,6 +34,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     attention: str = "reference"  # "reference" (train) | "flash" (serve)
+    # flash tile size; 0 = library default (SPARKDL_TPU_FLASH_BLOCK read
+    # once at import, else 128). Part of the config so sweeps retune the
+    # kernel through the jit cache key instead of a trace-time env read.
+    flash_block: int = 0
     decode: bool = False          # KV-cache autoregressive mode
     max_cache_len: int = 2048     # KV-cache capacity for decoding
     # Paged KV cache (serving): page_size > 0 replaces the per-row
@@ -343,7 +347,8 @@ class Attention(nn.Module):
             from sparkdl_tpu.ops.attention import flash_attention
 
             attend = lambda q_, k_, v_: flash_attention(
-                q_, k_, v_, causal=True
+                q_, k_, v_, causal=True,
+                block=cfg.flash_block or None,
             )
         else:
             from sparkdl_tpu.parallel.ring_attention import (

@@ -147,8 +147,17 @@ def _engine_programs(dec_cfg, temperature):
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub)
             # inactive slots freeze: position pinned (their junk
-            # write is overwritten in place, never visible)
-            pos = jnp.where(active, pos + 1, pos)
+            # write is overwritten in place, never visible). Active
+            # slots clamp at the last cache row: chunk lengths round
+            # up to a power of two, so a slot whose budget ends
+            # mid-chunk keeps stepping — without the clamp its writes
+            # would pass max_cache_len (out of bounds for the dense
+            # scatter, junk into a neighbour's page when paged). The
+            # overshot tokens are discarded host-side.
+            pos = jnp.where(
+                active,
+                jnp.minimum(pos + 1, dec_cfg.max_cache_len - 1),
+                pos)
             return (st["cache"], nxt, pos, rng), nxt
 
         (cache, token, pos, rng), toks = jax.lax.scan(
@@ -658,6 +667,11 @@ class ContinuousBatchingEngine:
     def run(self, progress=None, on_token=None):
         """Drain the queue; returns {req_id: generated tokens}.
 
+        Each ``run()`` returns only the requests finished during THIS
+        drain — completed results are handed to the caller and cleared,
+        so a reused engine neither replays old bursts nor grows its
+        result map without bound.
+
         ``on_token(req_id, token)``: streaming callback invoked for
         every accepted token in generation order (a serving front-end
         pushes these to clients; delivery granularity is the decode
@@ -710,8 +724,9 @@ class ContinuousBatchingEngine:
             # to a power of two — the scan program compiles O(log
             # chunk) times total instead of once per distinct tail
             # length. Overshoot is discarded host-side (same as
-            # mid-chunk eos). Cache capacity can never bind: submit()
-            # guarantees p_len + max_new <= max_cache_len per slot.
+            # mid-chunk eos); decode_chunk clamps the position advance
+            # at max_cache_len-1 so overshot steps of a budget-exhausted
+            # slot can never write past the cache.
             need = min(s.remaining for s in self._slots if s.active)
             n = 1
             while n < need and n < self.chunk:
@@ -752,4 +767,6 @@ class ContinuousBatchingEngine:
             self.stats["active_slot_steps"]
             / max(1, self.stats["total_slot_steps"])
         )
-        return dict(self._results)
+        out = self._results
+        self._results = {}
+        return out

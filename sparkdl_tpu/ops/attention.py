@@ -9,11 +9,16 @@ for training end to end.
 """
 
 import functools
+import os
 
 import jax
 
 from sparkdl_tpu.ops._dispatch import block_for, pad_to as _pad_to, use_pallas as _use_pallas
 from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+# Process-level default tile, read ONCE at import (see flash_attention's
+# docstring for why a trace-time env read would be a footgun).
+_DEFAULT_FLASH_BLOCK = int(os.environ.get("SPARKDL_TPU_FLASH_BLOCK", 128))
 
 
 # custom_vjp over the PADDED (B, H, S, D) core: both forward and
@@ -65,10 +70,20 @@ def _flash_core_bwd(causal, scale, block, interpret, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None):
+def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None,
+                    block=None):
     """Fused attention on (batch, seq, heads, head_dim) tensors —
     pallas forward AND backward on TPU (or ``interpret=True`` for
     tests); XLA reference elsewhere.
+
+    ``block``: q/k tile size (larger tiles amortize K/V streaming and
+    widen the per-program matmuls at short seq). Defaults to
+    ``SPARKDL_TPU_FLASH_BLOCK`` read ONCE at import — callers are
+    jitted and the env var is not part of the jit cache key, so a
+    mid-process env change must never silently retune (or fail to
+    retune) an already-traced program. Sweeps pass ``block``
+    explicitly (via ``LlamaConfig.flash_block``), which changes the
+    traced call and therefore the cache key.
     """
     if interpret is None:
         if not _use_pallas():
@@ -78,13 +93,7 @@ def flash_attention(q, k, v, *, causal=True, scale=None, interpret=None):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     s = qt.shape[2]
-    # SPARKDL_TPU_FLASH_BLOCK: bench_variants' flash tile sweep (larger
-    # q/k tiles amortize K/V streaming and widen the per-program
-    # matmuls at short seq). Scoped HERE so the knob cannot retune
-    # unrelated pallas kernels that share block_for.
-    import os
-
-    tile = int(os.environ.get("SPARKDL_TPU_FLASH_BLOCK", 128))
+    tile = int(block) if block else _DEFAULT_FLASH_BLOCK
     block = block_for(s, tile=tile)
     qt, pad = _pad_to(qt, block, 2)
     if pad and not causal:
