@@ -1,10 +1,10 @@
 #!/bin/bash
-# One-lease capture of every TPU artifact round 4 needs, ordered by
+# One-lease capture of every TPU artifact round 5 needs, ordered by
 # value so a re-wedge mid-run still leaves the most important numbers:
 #   1. bench.py headline  -> benchmarks/results/headline_cache.json
-#   2. variants sweep     -> benchmarks/results/variants_r4.jsonl
-#   3. collectives --tpu  -> /tmp/allreduce_tpu_r4.json (merged later)
-#   4. decode bench       -> benchmarks/results/decode_r4.json
+#   2. variants sweep     -> benchmarks/results/variants_r5.jsonl
+#   3. collectives --tpu  -> /tmp/allreduce_tpu_r5.json (merged later)
+#   4. decode bench       -> benchmarks/results/decode_r5.json
 # Run FROM the repo root on the TPU host. Writes a DONE marker with a
 # per-step status summary. Never runs two TPU scripts concurrently:
 # after every step, stray children of a timed-out bench (they live in
@@ -27,9 +27,9 @@ reap() {
 echo "[homecoming] 1/4 headline bench"
 # budget > bench.py's own worst case (probe schedule ~13-19 min +
 # RUN_TIMEOUT 1500 s); -k covers children that shrug off SIGTERM
-if timeout -k 30 2900 python bench.py > /tmp/headline_r4.json \
-     2>/tmp/headline_r4.err; then
-  if grep -q '"stale"' /tmp/headline_r4.json; then
+if timeout -k 30 2900 python bench.py > /tmp/headline_r5.json \
+     2>/tmp/headline_r5.err; then
+  if grep -q '"stale"' /tmp/headline_r5.json; then
     summary+="headline=stale-cache-only "   # no on-chip run happened
   else
     summary+="headline=ok "
@@ -42,7 +42,7 @@ reap
 echo "[homecoming] 2/4 variants sweep"
 if SPARKDL_TPU_VARIANTS_FULL=1 timeout -k 30 3600 \
      python benchmarks/bench_variants.py \
-     > benchmarks/results/variants_r4.jsonl 2>/tmp/variants_r4.err; then
+     > benchmarks/results/variants_r5.jsonl 2>/tmp/variants_r5.err; then
   summary+="variants=ok "
 else
   summary+="variants=rc$? "
@@ -51,7 +51,7 @@ reap
 
 echo "[homecoming] 3/4 collectives on-chip"
 if timeout -k 30 900 python benchmarks/allreduce_bench.py --tpu \
-     > /tmp/allreduce_tpu_r4.json 2>/tmp/allreduce_tpu_r4.err; then
+     > /tmp/allreduce_tpu_r5.json 2>/tmp/allreduce_tpu_r5.err; then
   summary+="collectives=ok "
 else
   summary+="collectives=rc$? "
@@ -60,7 +60,7 @@ reap
 
 echo "[homecoming] 4/4 decode bench"
 if timeout -k 30 2400 python benchmarks/decode_bench.py \
-     > benchmarks/results/decode_r4.json 2>/tmp/decode_r4.err; then
+     > benchmarks/results/decode_r5.json 2>/tmp/decode_r5.err; then
   summary+="decode=ok "
 else
   summary+="decode=rc$? "
