@@ -338,9 +338,10 @@ class Attention(nn.Module):
         # Attention policy (cfg.attention): "reference" = XLA fused
         # attention — best for TRAINING (native autodiff; the flash
         # kernel's backward currently recomputes densely). "flash" =
-        # pallas kernel — 4x faster forward at long sequence, the
-        # inference/serving path. Injectable attention_fn overrides
-        # both (ring attention under sequence parallelism).
+        # pallas kernel — 1.81x train step at seq 4096 in the round-2
+        # TPU sweep (BASELINE.md; pre-bf16-operand-fix, re-measure),
+        # the inference/serving path. Injectable attention_fn
+        # overrides both (ring attention under sequence parallelism).
         if self.attention_fn is not None:
             attend = self.attention_fn
         elif cfg.attention == "flash":
