@@ -14,6 +14,14 @@ import numpy as np
 
 
 def main():
+    # Same escape hatch as bench.py/model_bench: the axon sitecustomize
+    # pins jax_platforms at interpreter start, so without this a CPU
+    # run would initialize (and hang on a wedged) TPU lease.
+    plat = os.environ.get("SPARKDL_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     import pandas as pd
 
     from sparkdl.xgboost import XgboostClassifier

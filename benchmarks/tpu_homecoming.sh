@@ -49,7 +49,7 @@ else
 fi
 reap
 
-echo "[homecoming] 3/4 collectives on-chip"
+echo "[homecoming] 3/6 collectives on-chip"
 if timeout -k 30 900 python benchmarks/allreduce_bench.py --tpu \
      > /tmp/allreduce_tpu_r5.json 2>/tmp/allreduce_tpu_r5.err; then
   summary+="collectives=ok "
@@ -58,12 +58,31 @@ else
 fi
 reap
 
-echo "[homecoming] 4/4 decode bench"
+echo "[homecoming] 4/6 decode bench"
 if timeout -k 30 2400 python benchmarks/decode_bench.py \
      > benchmarks/results/decode_r5.json 2>/tmp/decode_r5.err; then
   summary+="decode=ok "
 else
   summary+="decode=rc$? "
+fi
+reap
+
+echo "[homecoming] 5/6 model families (ResNet-50 + BERT samples/sec/chip)"
+if timeout -k 30 1800 python benchmarks/model_bench.py \
+     > benchmarks/results/models_r5.json 2>/tmp/models_r5.err; then
+  summary+="models=ok "
+else
+  summary+="models=rc$? "
+fi
+reap
+
+echo "[homecoming] 6/6 profiler trace of a headline step"
+if timeout -k 30 900 python benchmarks/step_breakdown.py \
+     > benchmarks/results/step_breakdown_r5.json \
+     2>/tmp/step_breakdown_r5.err; then
+  summary+="profile=ok "
+else
+  summary+="profile=rc$? "
 fi
 reap
 
