@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Promote a bench_variants sweep winner into ``promoted.json``.
+
+Reads a variants JSONL (one ``{...config..., "tokens_per_sec": N}``
+line per variant), picks the fastest HEADLINE-SHAPED variant, and
+writes the promotion file bench.py consumes — so a sweep's winner
+lands as a data-only commit, and the selection itself is code under
+test instead of a human transcribing numbers.
+
+Only variants at the headline batch/seq (8x1024) are eligible: a
+seq-4096 remat winner is a different workload, not a faster headline.
+Error lines and off-shape variants are reported, never promoted.
+
+Usage: ``python benchmarks/promote.py results/variants_r5.jsonl``
+(writes ``benchmarks/promoted.json``; ``--dry-run`` prints instead).
+"""
+
+import json
+import os
+import sys
+
+HEADLINE = {"batch": 8, "seq": 1024}
+# Keys bench.py accepts (mirrors bench._PROMOTED_KEYS): anything else a
+# variant carries (batch/seq/remat/the measurement itself) is shape or
+# result, not config, and must not land in the promotion file.
+PROMOTABLE = ("attention", "loss", "chunk", "ce_bf16", "flash_block")
+
+
+def pick(lines):
+    """(winner_config, winner_tps, n_eligible) from parsed JSONL rows."""
+    best, best_tps, eligible = None, -1.0, 0
+    for row in lines:
+        if "tokens_per_sec" not in row:
+            continue  # error line — bench_variants keeps sweeping on OOM
+        if any(row.get(k, v) != v for k, v in HEADLINE.items()):
+            continue  # off-shape: different workload, not comparable
+        eligible += 1
+        if row["tokens_per_sec"] > best_tps:
+            best_tps = row["tokens_per_sec"]
+            best = {k: row[k] for k in PROMOTABLE if k in row}
+    return best, best_tps, eligible
+
+
+def main(argv):
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(__doc__)
+    dry = "--dry-run" in argv
+    src = [a for a in argv if not a.startswith("-")][0]
+    rows = []
+    with open(src) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                rows.append(json.loads(ln))
+    best, tps, eligible = pick(rows)
+    if best is None:
+        raise SystemExit(
+            f"promote: no eligible headline-shaped variant in {src} "
+            f"({len(rows)} rows)")
+    best["_promoted_from"] = {
+        "source": os.path.basename(src),
+        "tokens_per_sec": tps,
+        "eligible_variants": eligible,
+    }
+    # bench.py rejects unknown keys loudly — keep provenance OUT of the
+    # file it reads and in the sidecar instead.
+    prov = best.pop("_promoted_from")
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "promoted.json")
+    payload = json.dumps(best, indent=2, sort_keys=True) + "\n"
+    sidecar = json.dumps(prov, indent=2, sort_keys=True) + "\n"
+    if dry:
+        print(payload, end="")
+        print(sidecar, end="", file=sys.stderr)
+        return
+    with open(out_path, "w") as f:
+        f.write(payload)
+    with open(out_path + ".provenance", "w") as f:
+        f.write(sidecar)
+    print(f"promote: wrote {out_path} "
+          f"({tps} t/s over {eligible} eligible variants)")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

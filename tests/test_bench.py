@@ -295,3 +295,82 @@ def test_bench_fails_fast_when_backend_unavailable():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["value"] is None
     assert "unavailable" in out["error"]
+
+
+class TestPromote:
+    """Sweep -> promote -> headline, end to end off-chip: the selection
+    logic is code (benchmarks/promote.py), so the untested step of the
+    promotion pipeline is no longer a human reading a JSONL."""
+
+    VARIANTS = [
+        {"attention": "reference", "batch": 8, "seq": 1024,
+         "tokens_per_sec": 90000.0},
+        {"attention": "reference", "batch": 8, "seq": 1024,
+         "loss": "fused", "chunk": 512, "tokens_per_sec": 99000.0},
+        # fastest overall but OFF-SHAPE: must not be promoted
+        {"attention": "flash", "batch": 16, "seq": 1024,
+         "tokens_per_sec": 120000.0},
+        # long-context variant: different workload, ineligible
+        {"attention": "flash", "batch": 4, "seq": 4096, "remat": True,
+         "tokens_per_sec": 130000.0},
+        # error line: swept over, never promoted
+        {"attention": "flash", "batch": 8, "seq": 1024,
+         "error": "RESOURCE_EXHAUSTED"},
+    ]
+
+    def _write_jsonl(self, tmp_path):
+        p = tmp_path / "variants.jsonl"
+        p.write_text("".join(json.dumps(v) + "\n" for v in self.VARIANTS))
+        return p
+
+    def test_picks_fastest_headline_shaped(self, tmp_path):
+        sys.path.insert(0, os.path.join(os.path.dirname(BENCH),
+                                        "benchmarks"))
+        try:
+            import promote
+        finally:
+            sys.path.pop(0)
+        best, tps, eligible = promote.pick(self.VARIANTS)
+        assert tps == 99000.0
+        assert eligible == 2  # the two 8x1024 measured variants
+        assert best == {"attention": "reference", "loss": "fused",
+                        "chunk": 512}
+
+    @pytest.mark.gang
+    def test_promoted_file_drives_the_bench(self, tmp_path):
+        jsonl = self._write_jsonl(tmp_path)
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(BENCH), "benchmarks",
+                          "promote.py"),
+             str(jsonl), "--dry-run"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr[-400:]
+        promo = tmp_path / "promoted.json"
+        promo.write_text(r.stdout)
+        # bench.py must accept the file promote.py wrote verbatim
+        # (contract lock between the two ends of the pipeline)
+        b = _run({
+            "SPARKDL_TPU_BENCH_PLATFORM": "cpu",
+            "SPARKDL_TPU_BENCH_TINY": "1",
+            "SPARKDL_TPU_BENCH_PROMOTED": str(promo),
+        })
+        assert b.returncode == 0, b.stderr[-800:]
+        out = json.loads(b.stdout.strip().splitlines()[-1])
+        assert out["promoted"] == {"attention": "reference",
+                                   "loss": "fused", "chunk": 512}
+
+    def test_no_eligible_variant_fails_loudly(self, tmp_path):
+        p = tmp_path / "variants.jsonl"
+        p.write_text(json.dumps(
+            {"attention": "flash", "batch": 4, "seq": 4096,
+             "tokens_per_sec": 1.0}) + "\n")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(BENCH), "benchmarks",
+                          "promote.py"), str(p)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode != 0
+        assert "no eligible headline-shaped variant" in r.stderr
