@@ -497,3 +497,49 @@ def test_engine_rejects_oversized_request(setup):
     # reaches the ==0 finish condition)
     with pytest.raises(ValueError, match="max_new_tokens"):
         eng.submit(np.zeros(4, np.int32), 0)
+
+
+def test_engine_reuse_returns_only_current_burst(setup):
+    """run() hands back exactly the requests finished during THAT
+    drain: a reused engine must neither replay the previous burst's
+    results nor accumulate them unboundedly (advisor r4 finding), and
+    every burst must still match the single-stream oracle — slot state
+    from burst one must not leak into burst two's decode."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(7)
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4)
+
+    p1 = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    r1 = eng.submit(p1, 6)
+    out1 = eng.run()
+    assert set(out1) == {r1}
+    np.testing.assert_array_equal(out1[r1], _oracle(model, params, p1, 6))
+
+    r2 = eng.submit(p2, 7)
+    out2 = eng.run()
+    assert set(out2) == {r2}, "second burst replayed earlier results"
+    np.testing.assert_array_equal(out2[r2], _oracle(model, params, p2, 7))
+
+
+def test_engine_budget_exactly_fills_cache(setup):
+    """p_len + max_new == max_cache_len, with a chunk size that does
+    NOT divide the budget: the power-of-two chunk rounding overshoots
+    the final position, and the decode-side clamp must keep those junk
+    steps inside the cache (advisor r4 finding — before the clamp the
+    overshoot wrote out of bounds). Tokens must match the oracle to
+    the very last cache row, dense and paged."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(8)
+    p = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    budget = cfg.max_cache_len - len(p)  # 91: fills the cache exactly
+    oracle = _oracle(model, params, p, budget)
+    for page_size in (0, 16):
+        eng = ContinuousBatchingEngine(
+            model, params, n_slots=2, chunk=8, page_size=page_size)
+        rid = eng.submit(p, budget)
+        out = eng.run()
+        np.testing.assert_array_equal(
+            out[rid], oracle,
+            err_msg=f"page_size={page_size} diverged at full-cache budget",
+        )
