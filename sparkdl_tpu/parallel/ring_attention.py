@@ -25,15 +25,24 @@ NEG_INF = -1e30
 def _block_attend(q, k, v, mask, scale):
     """One (q-block × kv-block) attention piece with its own softmax
     stats. Shapes: q (B,Sq,H,D), k/v (B,Sk,H,D), mask (Sq,Sk) or None.
-    Returns (o, m, l): unnormalized output, row max, row sum."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    Returns (o, m, l): unnormalized output, row max, row sum.
+
+    Matmuls run in the INPUT dtype with fp32 accumulation
+    (``preferred_element_type``): upcasting bf16 operands to fp32
+    first would push the MXU to its multi-pass fp32 rate (the same
+    throttle the round-4 flash-kernel fix removed), while softmax
+    statistics and the accumulators stay fp32 for stability — the
+    standard flash-attention numerics."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)                      # (B,H,Sq)
     p = jnp.exp(s - m[..., None])
     p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
     l = jnp.sum(p, axis=-1)                      # (B,H,Sq)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o, m, l
 
 
@@ -48,7 +57,6 @@ def ring_self_attention(q, k, v, *, axis_name, causal=True, scale=None):
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = scale or (d ** -0.5)
-    q32 = q.astype(jnp.float32)
 
     q_pos = idx * s_local + jnp.arange(s_local)
 
@@ -63,8 +71,7 @@ def ring_self_attention(q, k, v, *, axis_name, causal=True, scale=None):
     def step(carry, _):
         k_blk, v_blk, src, acc_o, acc_m, acc_l = carry
         mask = make_mask(src) if causal else None
-        o, m, l = _block_attend(q32, k_blk.astype(jnp.float32),
-                                v_blk.astype(jnp.float32), mask, scale)
+        o, m, l = _block_attend(q, k_blk, v_blk, mask, scale)
         new_m = jnp.maximum(acc_m, m)
         a = jnp.exp(acc_m - new_m)
         bfac = jnp.exp(m - new_m)
@@ -89,17 +96,28 @@ def ring_self_attention(q, k, v, *, axis_name, causal=True, scale=None):
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None):
-    """Dense single-device attention (test oracle / small-model path)."""
+    """Dense single-device attention (test oracle / the headline
+    TRAINING path — ``LlamaConfig.attention="reference"``).
+
+    Same MXU discipline as :func:`_block_attend`: scores and the PV
+    product run in the input dtype with fp32 accumulation; only the
+    softmax itself is fp32. For fp32 inputs (every oracle test) this
+    is bit-identical to the old always-upcast version; for the bf16
+    training path it keeps the two big einsums at full MXU rate."""
     d = q.shape[-1]
     scale = scale or (d ** -0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         sq, sk = s.shape[-2:]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    # back to the activation dtype: a silently-fp32 output would
+    # upcast the caller's o_proj matmul (the throttle this fix removes)
+    return o.astype(v.dtype)
 
 
 def make_ring_attention(mesh, *, causal=True):
