@@ -50,12 +50,20 @@ class BertSelfAttention(nn.Module):
         k = dense("k_proj")(x).reshape(b, s, cfg.n_heads, head_dim)
         v = dense("v_proj")(x).reshape(b, s, cfg.n_heads, head_dim)
         if attention_mask is not None:
-            # padding mask → big-negative bias on masked keys
-            s_qk = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                              k.astype(jnp.float32)) * (head_dim ** -0.5)
+            # padding mask → big-negative bias on masked keys.
+            # Input-dtype operands with fp32 accumulation: an fp32
+            # upcast would throttle the MXU on the bf16 training path
+            # (same discipline as ring_attention.attention_reference).
+            s_qk = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32,
+            ) * (head_dim ** -0.5)
             bias = jnp.where(attention_mask[:, None, None, :], 0.0, -1e30)
             p = nn.softmax(s_qk + bias, axis=-1)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+            o = jnp.einsum(
+                "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                preferred_element_type=jnp.float32,
+            ).astype(v.dtype)
         else:
             o = attention_reference(q, k, v, causal=False)
         o = o.reshape(b, s, cfg.d_model)

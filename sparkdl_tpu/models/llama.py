@@ -245,15 +245,18 @@ class Attention(nn.Module):
             if rep > 1:
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            q32 = q.astype(jnp.float32)
+            # input-dtype operands, fp32 accumulation (same MXU
+            # discipline as attention_reference — no fp32 upcast)
             scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q32, k.astype(jnp.float32)
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32,
             ) * (head_dim ** -0.5)
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum(
-                "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
-            ).reshape(b, s, cfg.n_heads * head_dim)
+                "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                preferred_element_type=jnp.float32,
+            ).astype(v.dtype).reshape(b, s, cfg.n_heads * head_dim)
             return _apply_dense(cfg, cfg.d_model, "o_proj", o, adapter_ids)
 
         if cfg.decode:
@@ -316,16 +319,18 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             # masked attention over the cache: key t visible iff
-            # t <= query position
-            q32 = q.astype(jnp.float32)
+            # t <= query position; input-dtype operands with fp32
+            # accumulation (no fp32 upcast of the cache read)
             scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q32, k.astype(jnp.float32)
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32,
             ) * (head_dim ** -0.5)
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum(
-                "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
-            ).reshape(b, s, cfg.n_heads * head_dim)
+                "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                preferred_element_type=jnp.float32,
+            ).astype(v.dtype).reshape(b, s, cfg.n_heads * head_dim)
             return _apply_dense(cfg, cfg.d_model, "o_proj", o, adapter_ids)
 
         q = apply_rope(q, cos, sin, positions)
