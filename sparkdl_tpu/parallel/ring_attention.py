@@ -120,15 +120,216 @@ def attention_reference(q, k, v, *, causal=True, scale=None):
     return o.astype(v.dtype)
 
 
-def make_ring_attention(mesh, *, causal=True):
+# ---------------------------------------------------------------------------
+# Ring-flash: pallas flash kernels INSIDE the ring (long-context scale).
+#
+# The dense ring above materializes a (B, H, S_local, S_local) fp32
+# score matrix every ring step — at the sequence lengths sequence
+# parallelism exists for (S_local in the thousands), that buffer is the
+# memory wall.  Here each ring step runs the fused pallas forward on
+# the resident K/V block (O(S_local · D) memory), and normalized
+# partials merge in logsumexp form.  The backward is a SECOND ring
+# pass (custom_vjp): with the forward's final lse and delta = Σ do·o,
+# the flash backward restricted to one K/V block is exactly the
+# block's contribution, so dq accumulates locally while dk/dv
+# accumulators rotate WITH their blocks and arrive home after n hops
+# (blockwise-parallel ring attention; same decomposition the in-tree
+# dq/dkv kernels already implement across tiles within a block).
+#
+# Visibility schedule (causal): at hop t the resident block came from
+# rank src = (idx - t) mod n — src == idx is the causal diagonal
+# (t = 0, unrolled before the scan), src < idx is fully visible,
+# src > idx is fully masked and skipped without touching the MXU.
+# ---------------------------------------------------------------------------
+
+
+def _ring_flash_fwd_pass(qt, k0, v0, axis_name, causal, scale, bq, bk,
+                         interpret):
+    """Ring of flash-forward blocks. qt/k0/v0 are (B,H,S,D) local
+    shards; returns (o_norm f32, lse f32 (B,H,S,1))."""
+    from sparkdl_tpu.ops.pallas.flash_attention import (
+        flash_attention_bhsd,
+    )
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, h, s, d = qt.shape
+
+    def attend(k_blk, v_blk, diag):
+        o, lse = flash_attention_bhsd(
+            qt, k_blk, v_blk, causal=diag and causal, scale=scale,
+            bq=bq, bk=bk, interpret=interpret, return_lse=True,
+        )
+        return o.astype(jnp.float32), lse
+
+    # hop 0: the resident (own) block — the causal diagonal
+    acc_o, acc_lse = attend(k0, v0, diag=True)
+
+    def step(carry, _):
+        k_blk, v_blk, src, acc_o, acc_lse = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (src - 1) % n
+        if causal:
+            o, lse = jax.lax.cond(
+                src < idx,
+                lambda: attend(k_blk, v_blk, diag=False),
+                lambda: (jnp.zeros((b, h, s, d), jnp.float32),
+                         jnp.full((b, h, s, 1), NEG_INF, jnp.float32)),
+            )
+        else:
+            o, lse = attend(k_blk, v_blk, diag=False)
+        new_lse = jnp.logaddexp(acc_lse, lse)
+        acc_o = (acc_o * jnp.exp(acc_lse - new_lse)
+                 + o * jnp.exp(lse - new_lse))
+        return (k_blk, v_blk, src, acc_o, new_lse), None
+
+    (_, _, _, acc_o, acc_lse), _ = jax.lax.scan(
+        step, (k0, v0, idx, acc_o, acc_lse), None, length=n - 1
+    )
+    return acc_o, acc_lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, bq, bk, interpret):
+    out, _ = _ring_flash_core(q, k, v, axis_name, causal, scale, bq,
+                              bk, interpret)
+    return out
+
+
+def _ring_flash_core(q, k, v, axis_name, causal, scale, bq, bk,
+                     interpret):
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    acc_o, acc_lse = _ring_flash_fwd_pass(
+        qt, kt, vt, axis_name, causal, scale, bq, bk, interpret
+    )
+    out = acc_o.astype(q.dtype).transpose(0, 2, 1, 3)
+    return out, acc_lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, bq, bk,
+                    interpret):
+    out, lse = _ring_flash_core(q, k, v, axis_name, causal, scale, bq,
+                                bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, bq, bk, interpret, res,
+                    do):
+    from sparkdl_tpu.ops.pallas.flash_attention import (
+        flash_attention_bwd_bhsd,
+    )
+
+    q, k, v, out, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.astype(jnp.float32).transpose(0, 2, 1, 3)
+    ot = out.astype(jnp.float32).transpose(0, 2, 1, 3)
+    delta = jnp.sum(dot * ot, axis=-1, keepdims=True)  # (B,H,S,1)
+    dot = dot.astype(qt.dtype)
+
+    def block_bwd(k_blk, v_blk, diag):
+        return flash_attention_bwd_bhsd(
+            qt, k_blk, v_blk, dot, lse, delta,
+            causal=diag and causal, scale=scale, bq=bq, bk=bk,
+            interpret=interpret,
+        )
+
+    zeros_kv = jnp.zeros(kt.shape, jnp.float32)
+
+    # hop 0: diagonal block (own k/v)
+    dq0, dk0, dv0 = block_bwd(kt, vt, diag=True)
+    dq_acc = dq0.astype(jnp.float32)
+
+    def step(carry, _):
+        k_blk, v_blk, dk_acc, dv_acc, src, dq_acc = carry
+        # rotate the block AND its gradient accumulator together: after
+        # the remaining n-1 hops both are back on the block's home rank
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        src = (src - 1) % n
+
+        def live():
+            dq_c, dk_c, dv_c = block_bwd(k_blk, v_blk, diag=False)
+            return (dq_c.astype(jnp.float32),
+                    dk_c.astype(jnp.float32),
+                    dv_c.astype(jnp.float32))
+
+        if causal:
+            dq_c, dk_c, dv_c = jax.lax.cond(
+                src < idx,
+                live,
+                lambda: (jnp.zeros(qt.shape, jnp.float32), zeros_kv,
+                         zeros_kv),
+            )
+        else:
+            dq_c, dk_c, dv_c = live()
+        return (k_blk, v_blk, dk_acc + dk_c, dv_acc + dv_c, src,
+                dq_acc + dq_c), None
+
+    carry = (kt, vt, dk0.astype(jnp.float32), dv0.astype(jnp.float32),
+             idx, dq_acc)
+    (k_blk, v_blk, dk_acc, dv_acc, _, dq_acc), _ = jax.lax.scan(
+        step, carry, None, length=n - 1
+    )
+    # one more hop brings each accumulator from the rank that computed
+    # the LAST contribution back to the block's home rank
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    dq = dq_acc.astype(q.dtype).transpose(0, 2, 1, 3)
+    dk = dk_acc.astype(k.dtype).transpose(0, 2, 1, 3)
+    dv = dv_acc.astype(v.dtype).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, *, axis_name, causal=True, scale=None,
+                         bq=128, bk=128, interpret=False):
+    """Ring attention whose per-block compute is the fused pallas flash
+    kernel — O(S_local · D) memory per hop instead of the dense ring's
+    O(S_local²) score matrix, with a fused two-ring backward.  Same
+    contract as :func:`ring_self_attention`: (batch, seq_local, heads,
+    head_dim) shards inside ``shard_map`` over ``axis_name``."""
+    d = q.shape[-1]
+    scale = scale or (d ** -0.5)
+    return _ring_flash(q, k, v, axis_name, causal, scale, bq, bk,
+                       interpret)
+
+
+def make_ring_attention(mesh, *, causal=True, impl="dense",
+                        interpret=False):
     """Bind ring attention to a mesh: returns f(q, k, v) taking GLOBAL
-    (b, s, h, d) arrays sharded (data, seq, None, None)."""
+    (b, s, h, d) arrays sharded (data, seq, None, None).
+
+    ``impl``: "dense" (XLA block attend — any backend, the test
+    oracle's numerics) or "flash" (pallas blocks — the long-context
+    TPU path; ``interpret=True`` runs the kernels interpreted for
+    tests off-TPU)."""
     from jax.sharding import PartitionSpec as P
 
     spec = P("data", "seq", None, None)
-    fn = functools.partial(
-        ring_self_attention, axis_name="seq", causal=causal
-    )
+    if impl == "flash":
+        fn = functools.partial(
+            ring_flash_attention, axis_name="seq", causal=causal,
+            interpret=interpret,
+        )
+    elif impl == "dense":
+        fn = functools.partial(
+            ring_self_attention, axis_name="seq", causal=causal
+        )
+    else:
+        raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
     return jax.jit(jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
