@@ -307,17 +307,21 @@ def ring_flash_attention(q, k, v, *, axis_name, causal=True, scale=None,
                        interpret)
 
 
-def make_ring_attention(mesh, *, causal=True, impl="dense",
+def make_ring_attention(mesh, *, causal=True, impl=None,
                         interpret=False):
     """Bind ring attention to a mesh: returns f(q, k, v) taking GLOBAL
     (b, s, h, d) arrays sharded (data, seq, None, None).
 
     ``impl``: "dense" (XLA block attend — any backend, the test
-    oracle's numerics) or "flash" (pallas blocks — the long-context
+    oracle's numerics), "flash" (pallas blocks — the long-context
     TPU path; ``interpret=True`` runs the kernels interpreted for
-    tests off-TPU)."""
+    tests off-TPU), or None = flash on TPU, dense elsewhere."""
     from jax.sharding import PartitionSpec as P
 
+    from sparkdl_tpu.ops._dispatch import use_pallas
+
+    if impl is None:
+        impl = "flash" if use_pallas() else "dense"
     spec = P("data", "seq", None, None)
     if impl == "flash":
         fn = functools.partial(
