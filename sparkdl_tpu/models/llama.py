@@ -47,6 +47,16 @@ class LlamaConfig:
     # positions) and block_tables passed to __call__.
     page_size: int = 0
     n_pages: int = 0
+    # Paged decode attention kernel: "auto" = pallas kernel on TPU for
+    # single-step decode (reads ONLY a row's own pages through the
+    # block table; the XLA fallback gathers the whole logical view and
+    # repeats K/V for GQA — ~3x the HBM traffic on a bandwidth-bound
+    # step), "off" = always the gather path, "force_interpret" = run
+    # the kernel interpreted off-TPU (tests). SINGLE-DEVICE ONLY: a
+    # raw pallas_call cannot be partitioned by GSPMD, so under a TP
+    # mesh (head-sharded pool) use "off" — the serving engine does
+    # this automatically when built with mesh=.
+    paged_kernel: str = "auto"
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
@@ -66,6 +76,13 @@ class LlamaConfig:
     moe_every: int = 1
 
     def __post_init__(self):
+        if self.paged_kernel not in ("auto", "off", "force_interpret"):
+            # a typo'd value would silently behave like "auto" in the
+            # dispatch (same lesson as make_ring_attention's impl check)
+            raise ValueError(
+                f"paged_kernel must be 'auto', 'off', or "
+                f"'force_interpret', got {self.paged_kernel!r}"
+            )
         if self.multi_lora:
             attn_names = {"q_proj", "k_proj", "v_proj", "o_proj"}
             bad = set(self.lora_targets) - attn_names
@@ -235,6 +252,23 @@ class Attention(nn.Module):
                 tables, pos_dec // P, axis=1)              # (b, s)
             ck.value = ck.value.at[page_of, pos_dec % P].set(k)
             cv.value = cv.value.at[page_of, pos_dec % P].set(v)
+            if s == 1 and cfg.paged_kernel != "off":
+                from sparkdl_tpu.ops._dispatch import use_pallas
+                from sparkdl_tpu.ops.pallas.paged_attention import (
+                    paged_attention_decode,
+                )
+
+                if (cfg.paged_kernel == "force_interpret"
+                        or use_pallas()):
+                    o = paged_attention_decode(
+                        q[:, 0], ck.value, cv.value, tables,
+                        pos_dec[:, 0] + 1,
+                        interpret=(cfg.paged_kernel
+                                   == "force_interpret"),
+                    )
+                    o = o.reshape(b, s, cfg.n_heads * head_dim)
+                    return _apply_dense(cfg, cfg.d_model, "o_proj", o,
+                                        adapter_ids)
             # read: gather each row's pages into its logical view
             L = tables.shape[1] * P
             k = ck.value[tables].reshape(b, L, cfg.n_kv_heads, head_dim)

@@ -236,6 +236,13 @@ class ContinuousBatchingEngine:
                        else int(n_slots) * self._max_pages + 1)
             cfg = dataclasses.replace(
                 cfg, page_size=self.page_size, n_pages=n_pages)
+            if mesh is not None and cfg.paged_kernel == "auto":
+                # A raw pallas_call cannot be partitioned by GSPMD:
+                # under TP serving the head-sharded pool would be
+                # all-gathered around the kernel. Gather-path decode
+                # shards fine; the kernel stays single-device until it
+                # grows a shard_map wrapper over the kv-head axis.
+                cfg = dataclasses.replace(cfg, paged_kernel="off")
         self.cfg = dataclasses.replace(cfg, decode=True)
         self.n_slots = int(n_slots)
         self.temperature = float(temperature)

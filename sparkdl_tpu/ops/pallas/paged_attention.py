@@ -1,0 +1,144 @@
+"""Paged-attention decode kernel: attend a single-step query over a
+POOLED paged KV cache through per-row block tables, reading only the
+pages a row actually owns.
+
+The XLA fallback in the model (``llama.py`` paged decode) gathers the
+whole logical view first — ``pool[tables]`` materializes a
+(B, max_pages·page, Hkv, D) copy in HBM and then reads it again for
+attention, plus a ``jnp.repeat`` copy of K/V for GQA. Decode is
+HBM-bandwidth-bound, so that ~3x traffic is ~3x step time at capacity.
+This kernel instead:
+
+- prefetches the block table and per-row lengths as SCALARS
+  (``PrefetchScalarGridSpec``) so each grid step's page index is known
+  before the body runs, and the pipeline DMAs exactly ONE (page, D)
+  K/V tile per (row, kv-head, page) program — pages beyond a row's
+  length are masked out, and rows share nothing;
+- keeps the whole GQA query group (``rep`` query heads per kv head) in
+  VMEM against that one tile — no repeated K/V, the MXU sees a
+  (rep, page) × (page, D) pair per step;
+- accumulates in the numerically-stable flash form (running max +
+  rescaled sums) across the sequential page axis in VMEM scratch.
+
+vLLM's paged_attention (CUDA) and the jax-in-tree TPU port are the
+published precedents for the scalar-prefetch pattern; this kernel is
+written for THIS engine's pool layout (page-major (n_pages, page,
+Hkv, D), dump-page 0 for padding junk — see models/serving.py).
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _kernel(page, rep, scale, n_pages_grid):
+    from jax.experimental import pallas as pl
+
+    def kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref):
+        b = pl.program_id(0)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        length = lens_ref[b]
+
+        @pl.when(j * page < length)
+        def _attend():
+            q = q_ref[0, 0]                       # (rep, D)
+            k = k_ref[0, :, 0, :]                 # (page, D)
+            v = v_ref[0, :, 0, :]                 # (page, D)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                             # (rep, page)
+            pos = j * page + jax.lax.broadcasted_iota(
+                jnp.int32, (1, page), 1)
+            s = jnp.where(pos < length, s, NEG_INF)
+            m_prev = m_ref[...]                   # (rep, 1)
+            l_prev = l_ref[...]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)                # (rep, page)
+            l_ref[...] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+            m_ref[...] = m_new
+            acc_ref[...] = (
+                acc_ref[...] * alpha
+                + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+        @pl.when(j == n_pages_grid - 1)
+        def _finalize():
+            o_ref[0, 0] = (
+                acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+            ).astype(o_ref.dtype)
+
+    return kernel
+
+
+def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
+                           scale=None, interpret=False):
+    """One decode step over the paged pool.
+
+    Args:
+      q: (B, H, D) — this step's queries, H = Hkv * rep (GQA).
+      k_pool, v_pool: (n_pages, page, Hkv, D) pooled physical cache.
+      tables: (B, max_pages) int32 block tables (unused slots may
+        point anywhere valid — typically the dump page 0; they are
+        masked by ``lens``).
+      lens: (B,) int32 — number of visible tokens per row (the row's
+        current position + 1: the just-written token attends to
+        itself).
+    Returns: (B, H, D) attention output in q.dtype.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    n_pages, page, hkv, dk = k_pool.shape
+    assert dk == d and h % hkv == 0, (q.shape, k_pool.shape)
+    rep = h // hkv
+    max_pages = tables.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    qg = q.reshape(b, hkv, rep, d)
+    tables = tables.astype(jnp.int32)
+    lens = lens.astype(jnp.int32)
+
+    grid = (b, hkv, max_pages)
+    # index maps see (grid..., *scalar_prefetch_refs)
+    q_spec = pl.BlockSpec(
+        (1, 1, rep, d), lambda bi, hi, j, tbl, ln: (bi, hi, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, page, 1, d), lambda bi, hi, j, tbl, ln: (tbl[bi, j], 0, hi, 0))
+    out_spec = pl.BlockSpec(
+        (1, 1, rep, d), lambda bi, hi, j, tbl, ln: (bi, hi, 0, 0))
+
+    out = pl.pallas_call(
+        _kernel(page, rep, scale, max_pages),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=out_spec,
+            scratch_shapes=[
+                pltpu.VMEM((rep, d), jnp.float32),   # acc
+                pltpu.VMEM((rep, 1), jnp.float32),   # running max
+                pltpu.VMEM((rep, 1), jnp.float32),   # running sum
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tables, lens, qg, k_pool, v_pool)
+    return out.reshape(b, h, d)

@@ -543,3 +543,37 @@ def test_engine_budget_exactly_fills_cache(setup):
             out[rid], oracle,
             err_msg=f"page_size={page_size} diverged at full-cache budget",
         )
+
+
+def test_paged_kernel_engine_matches_gather_and_oracle(setup):
+    """The pallas paged-attention decode kernel (interpreted off-TPU)
+    must be a drop-in for the gather path at the ENGINE level: same
+    tokens as the gather engine and the single-stream oracle across
+    admission, page-boundary crossings, and slot reuse."""
+    import dataclasses
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+        for n in (5, 9, 7)
+    ]
+    budgets = [6, 20, 9]  # 20 crosses a 16-token page boundary
+
+    cfg_k = dataclasses.replace(cfg, paged_kernel="force_interpret")
+    model_k = type(model)(cfg_k)
+    out = {}
+    for label, m in (("gather", model), ("kernel", model_k)):
+        eng = ContinuousBatchingEngine(m, params, n_slots=2, chunk=4,
+                                       page_size=16)
+        rids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+        out[label] = (rids, eng.run())
+    for (rid_g, rid_k, p, b) in zip(out["gather"][0], out["kernel"][0],
+                                    prompts, budgets):
+        oracle = _oracle(model, params, p, b)
+        np.testing.assert_array_equal(
+            out["gather"][1][rid_g], oracle,
+            err_msg="gather engine diverged from oracle")
+        np.testing.assert_array_equal(
+            out["kernel"][1][rid_k], oracle,
+            err_msg="paged-kernel engine diverged from oracle")

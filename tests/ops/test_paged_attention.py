@@ -1,0 +1,86 @@
+"""Paged-attention decode kernel vs the gather-everything oracle (the
+XLA path the model uses off-TPU): masked exact attention over each
+row's own pages, GQA groups, junk in unowned pages ignored."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.ops.pallas.paged_attention import paged_attention_decode
+
+
+def _oracle(q, k_pool, v_pool, tables, lens):
+    """Dense gather reference: pool[tables] -> logical view, mask by
+    lens, softmax attend (mirrors llama.py's paged decode branch)."""
+    b, h, d = q.shape
+    n_pages, page, hkv, _ = k_pool.shape
+    rep = h // hkv
+    L = tables.shape[1] * page
+    k = k_pool[tables].reshape(b, L, hkv, d)
+    v = v_pool[tables].reshape(b, L, hkv, d)
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (d ** -0.5)
+    mask = jnp.arange(L)[None, :] < lens[:, None]          # (b, L)
+    s = jnp.where(mask[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32))
+
+
+def _setup(rng, b, hkv, rep, d, page, pages_per_row, n_pages):
+    h = hkv * rep
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    k_pool = jnp.asarray(
+        rng.standard_normal((n_pages, page, hkv, d)), jnp.float32)
+    v_pool = jnp.asarray(
+        rng.standard_normal((n_pages, page, hkv, d)), jnp.float32)
+    # distinct pages per row; unused table slots point at dump page 0
+    perm = rng.permutation(np.arange(1, n_pages))
+    tables = np.zeros((b, pages_per_row), np.int32)
+    for i in range(b):
+        tables[i] = perm[i * pages_per_row:(i + 1) * pages_per_row]
+    return q, k_pool, v_pool, jnp.asarray(tables)
+
+
+@pytest.mark.parametrize("rep", [1, 4])
+def test_matches_gather_oracle(rep):
+    rng = np.random.default_rng(0)
+    b, hkv, d, page, ppr = 3, 2, 16, 8, 4
+    q, k_pool, v_pool, tables = _setup(rng, b, hkv, rep, d, page, ppr,
+                                       n_pages=b * ppr + 1)
+    # ragged lengths incl. a page-boundary case and a one-token row
+    lens = jnp.asarray([1, page * 2, page * ppr], jnp.int32)
+    out = paged_attention_decode(q, k_pool, v_pool, tables, lens,
+                                 interpret=True)
+    ref = _oracle(q, k_pool, v_pool, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_junk_pages_cannot_leak():
+    """Positions past a row's length live in pages full of huge values:
+    if masking or the page loop bound is wrong, the output shifts."""
+    rng = np.random.default_rng(1)
+    b, hkv, rep, d, page, ppr = 2, 2, 2, 16, 8, 3
+    q, k_pool, v_pool, tables = _setup(rng, b, hkv, rep, d, page, ppr,
+                                       n_pages=b * ppr + 1)
+    lens = jnp.asarray([5, 17], jnp.int32)
+    ref = _oracle(q, k_pool, v_pool, tables, lens)
+    # poison every position beyond each row's length (incl. dump page)
+    kp, vp = np.array(k_pool), np.array(v_pool)
+    for i in range(b):
+        for slot in range(ppr):
+            pg = int(np.asarray(tables)[i, slot])
+            for off in range(page):
+                if slot * page + off >= int(lens[i]):
+                    kp[pg, off] = 1e4
+                    vp[pg, off] = -1e4
+    kp[0] = 1e4
+    vp[0] = -1e4
+    out = paged_attention_decode(
+        q, jnp.asarray(kp), jnp.asarray(vp), tables, lens,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
