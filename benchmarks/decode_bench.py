@@ -127,9 +127,14 @@ def main():
         reqs = [(64 + 16 * (i % 5), 128 + 64 * (i % 4))
                 for i in range(24)]
 
-    def build_engine(seed, page_size=0):
+    def build_engine(seed, page_size=0, paged_kernel="auto"):
         gen = np.random.default_rng(seed)
-        eng = ContinuousBatchingEngine(model, params, n_slots=n_slots,
+        m = model
+        if paged_kernel != "auto":
+            from sparkdl_tpu.models.llama import Llama
+
+            m = Llama(dataclasses.replace(cfg, paged_kernel=paged_kernel))
+        eng = ContinuousBatchingEngine(m, params, n_slots=n_slots,
                                        chunk=chunk, page_size=page_size)
         for p, nt in reqs:
             eng.submit(
@@ -173,7 +178,27 @@ def main():
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
         "n_pages": eng_p.cfg.n_pages,
+        "paged_kernel": eng_p.cfg.paged_kernel,
         "vs_dense_engine": round((total_p / dt_p) / (total_new / dt), 3),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+    # Same paged stream with the pallas kernel forced OFF: the delta
+    # between this and the record above is the paged-attention
+    # kernel's win over the gather path (only meaningful on TPU,
+    # where "auto" uses the kernel).
+    build_engine(1, page_size, paged_kernel="off").run()  # warm
+    eng_g = build_engine(1, page_size, paged_kernel="off")
+    t0 = time.perf_counter()
+    results_g = eng_g.run()
+    dt_g = time.perf_counter() - t0
+    total_g = sum(len(v) for v in results_g.values())
+    print(json.dumps({
+        "metric": "llama_decode_paged_gather_tokens_per_sec",
+        "value": round(total_g / dt_g, 1),
+        "unit": "tokens/sec",
+        "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
+        "vs_paged_auto": round((total_g / dt_g) / (total_p / dt_p), 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
