@@ -757,19 +757,31 @@ class ContinuousBatchingEngine:
             self.stats["total_slot_steps"] += n * self.n_slots
             self.stats["active_slot_steps"] += int(active.sum()) * n
             for i, s in enumerate(self._slots):
-                if not s.active:
-                    continue
-                for t in toks[:, i]:
-                    s.tokens.append(int(t))
-                    s.remaining -= 1
-                    if self._on_token is not None:
-                        self._on_token(s.req_id, int(t))
-                    if ((self.eos_id is not None and int(t) == self.eos_id)
-                            or s.remaining == 0):
-                        self._finish(i)
-                        break
+                if s.active:
+                    self._accept_tokens(i, toks[:, i])
             if progress is not None:
                 progress(self)
+        return self._drain_results()
+
+    def _accept_tokens(self, slot_idx, tokens):
+        """Append generated tokens to a slot (streaming callback, eos
+        and budget enforcement). Returns True when the slot finished —
+        trailing tokens past eos/budget are discarded. ONE definition
+        shared by the chunked and the speculative decode loops."""
+        s = self._slots[slot_idx]
+        for t in tokens:
+            s.tokens.append(int(t))
+            s.remaining -= 1
+            if self._on_token is not None:
+                self._on_token(s.req_id, int(t))
+            if ((self.eos_id is not None and int(t) == self.eos_id)
+                    or s.remaining == 0):
+                self._finish(slot_idx)
+                return True
+        return False
+
+    def _drain_results(self):
+        """Final stats + hand the burst's results to the caller."""
         self.stats["utilization"] = (
             self.stats["active_slot_steps"]
             / max(1, self.stats["total_slot_steps"])
@@ -777,3 +789,218 @@ class ContinuousBatchingEngine:
         out = self._results
         self._results = {}
         return out
+
+
+# ---------------------------------------------------------------------------
+# Speculative continuous batching: the engine's slot scheduler composed
+# with draft-propose / target-verify rounds (models/speculative.py has
+# the single-burst lockstep version; production stacks run speculation
+# INSIDE the batching engine, per-slot).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _spec_engine_programs(dec_cfg, draft_cfg, k):
+    """(draft_prefill, draft_insert, spec_round) — jitted once per
+    (target config, draft config, k)."""
+    from sparkdl_tpu.models.llama import Llama
+
+    target = Llama(dec_cfg)
+    draft = Llama(draft_cfg)
+
+    @jax.jit
+    def draft_prefill(d_params, padded_prompt):
+        """Prompt through the DRAFT (logits discarded): its slot cache
+        only has to hold the prompt's K/V — junk pad rows beyond the
+        true length stay invisible under the position mask."""
+        _, st = draft.apply(
+            {"params": d_params}, padded_prompt, mutable=["cache"])
+        return st["cache"]
+
+    @jax.jit
+    def draft_insert(d_cache, one_cache, slot):
+        return jax.tree.map(
+            lambda full, one: (
+                full if full.ndim == 0 else full.at[slot].set(one[0])
+            ),
+            d_cache, one_cache,
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(1, 3))
+    def spec_round(params, cache, d_params, d_cache, token, pos,
+                   active):
+        """One speculation round over every slot: the draft scans k
+        greedy slot-mapped steps, then ONE target forward scores the
+        k+1 positions. Rejected rows above each slot's accepted
+        position are junk that the NEXT round's writes cover before
+        any query can see them (write window [pos', pos'+k] always
+        spans the previous round's junk because pos advances by at
+        most k+1)."""
+        L = dec_cfg.max_cache_len
+
+        def body(carry, _):
+            d_cache, tok, p = carry
+            logits, st = draft.apply(
+                {"params": d_params, "cache": d_cache}, tok[:, None],
+                positions=p[:, None], mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            p = jnp.where(active, jnp.minimum(p + 1, L - 1), p)
+            return (st["cache"], nxt, p), nxt
+
+        (d_cache, last_tok, last_p), prop = jax.lax.scan(
+            body, (d_cache, token, pos), None, length=k)
+        # one extra logits-discarded step writes the LAST proposal's
+        # K/V row: a fully-accepted round advances past it, and
+        # without this write the draft's next round attends a junk
+        # row — acceptance collapses (exactness is unaffected; the
+        # verify is authoritative). Same trick as
+        # speculative_generate's propose.
+        _, st = draft.apply(
+            {"params": d_params, "cache": d_cache}, last_tok[:, None],
+            positions=last_p[:, None], mutable=["cache"],
+        )
+        d_cache = st["cache"]
+        prop = prop.T                                     # (b, k)
+
+        offs = jnp.arange(k + 1)
+        ppos = jnp.minimum(pos[:, None] + offs[None, :], L - 1)
+        ppos = jnp.where(active[:, None], ppos, pos[:, None])
+        seq = jnp.concatenate([token[:, None], prop], axis=1)
+        logits, st = target.apply(
+            {"params": params, "cache": cache}, seq, positions=ppos,
+            mutable=["cache"],
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return st["cache"], d_cache, prop, greedy         # (b, k+1)
+
+    return draft_prefill, draft_insert, spec_round
+
+
+class SpeculativeBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching with per-slot speculative decoding: an int8
+    (or any same-interface) DRAFT proposes ``k`` tokens per slot, one
+    target forward verifies all slots, and each slot independently
+    accepts its longest agreeing prefix plus the target's bonus token
+    — greedy outputs are EXACTLY the plain engine's (speculative
+    identity per slot; no lockstep barrier like
+    :func:`speculative_generate`'s whole-batch agree).
+
+    v1 scope (raises otherwise): dense slot cache (no paging), greedy
+    (temperature 0), single adapter, no prefix caching, no TP mesh.
+    """
+
+    def __init__(self, model, params, draft_params, *, n_slots=4,
+                 eos_id=None, k=4, rng=None, draft_model=None):
+        cfg = model.cfg
+        if cfg.page_size:
+            raise ValueError(
+                "SpeculativeBatchingEngine v1 is dense-cache only")
+        if cfg.multi_lora:
+            raise ValueError(
+                "SpeculativeBatchingEngine v1 is single-adapter only")
+        super().__init__(model, params, n_slots=n_slots,
+                         temperature=0.0, eos_id=eos_id, rng=rng)
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        d_base = draft_model.cfg if draft_model is not None else cfg
+        self._draft_cfg = dataclasses.replace(
+            d_base, decode=True, max_cache_len=self.cfg.max_cache_len,
+            page_size=0, n_pages=0,
+        )
+        self.draft_params = draft_params
+        from sparkdl_tpu.models.llama import Llama
+
+        dummy = jnp.zeros((self.n_slots, 1), jnp.int32)
+        self._d_cache = Llama(self._draft_cfg).init(
+            jax.random.PRNGKey(1), dummy,
+            positions=jnp.zeros((self.n_slots, 1), jnp.int32),
+        )["cache"]
+        self.stats.update(rounds=0, proposed=0, accepted=0)
+
+    @property
+    def _spec_programs(self):
+        return _spec_engine_programs(self.cfg, self._draft_cfg, self.k)
+
+    def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
+               adapter_id=0):
+        if prefix_id is not None:
+            raise ValueError(
+                "SpeculativeBatchingEngine v1 has no prefix caching "
+                "(the draft would need its own prefix cache)")
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        # + k scratch: a verify may write k positions past the final
+        # accepted token; the in-kernel clamp keeps writes in bounds
+        # but exactness needs rows past the budget to be SCRATCH, not
+        # a neighbour's data — so the whole window must fit.
+        if len(prompt) + max_new_tokens + self.k > self.cfg.max_cache_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) + k ({self.k}) speculation "
+                f"scratch exceeds max_cache_len "
+                f"({self.cfg.max_cache_len}); raise max_cache_len or "
+                "lower k"
+            )
+        return super().submit(prompt, max_new_tokens,
+                              adapter_id=adapter_id)
+
+    def _admit(self, slot_idx):
+        # capture before super() pops the queue head
+        _, prompt, _, _, _ = self._queue[0]
+        super()._admit(slot_idx)
+        if not self._slots[slot_idx].active:
+            # instantly finished (first token was eos / 1-token
+            # budget): the slot will be re-admitted fresh — don't pay
+            # a draft prefill + full-tree insert for it
+            return
+        draft_prefill, draft_insert, _ = self._spec_programs
+        bucket = min(_bucket(len(prompt)), self.cfg.max_cache_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        one = draft_prefill(self.draft_params, jnp.asarray(padded))
+        self._d_cache = draft_insert(self._d_cache, one, slot_idx)
+
+    def _run(self, progress):
+        _, _, spec_round = self._spec_programs
+        while self._queue or any(s.active for s in self._slots):
+            for i, s in enumerate(self._slots):
+                if not s.active and self._queue:
+                    self._admit(i)
+            active = np.array([s.active for s in self._slots])
+            if not active.any():
+                continue
+            (self._cache, self._d_cache, prop, greedy) = spec_round(
+                self.params, self._cache, self.draft_params,
+                self._d_cache, self._token, self._pos,
+                jnp.asarray(active),
+            )
+            prop = np.asarray(prop)                   # (b, k)
+            greedy = np.asarray(greedy)               # (b, k+1)
+            n_act = int(active.sum())
+            self.stats["rounds"] += 1
+            self.stats["proposed"] += self.k * n_act
+            self.stats["steps"] += 1
+            self.stats["total_slot_steps"] += self.n_slots
+            self.stats["active_slot_steps"] += n_act
+            new_pos = np.asarray(self._pos).copy()
+            new_tok = np.asarray(self._token).copy()
+            for i, s in enumerate(self._slots):
+                if not s.active:
+                    continue
+                agree = prop[i] == greedy[i, :self.k]
+                m = (int(np.argmin(agree)) if not agree.all()
+                     else self.k)
+                self.stats["accepted"] += m
+                accepted = list(prop[i, :m]) + [greedy[i, m]]
+                if not self._accept_tokens(i, accepted):
+                    new_pos[i] += m + 1
+                    new_tok[i] = greedy[i, m]
+            self._pos = jnp.asarray(new_pos)
+            self._token = jnp.asarray(new_tok)
+            if progress is not None:
+                progress(self)
+        self.stats["acceptance_rate"] = (
+            self.stats["accepted"] / max(1, self.stats["proposed"])
+        )
+        return self._drain_results()

@@ -577,3 +577,84 @@ def test_paged_kernel_engine_matches_gather_and_oracle(setup):
         np.testing.assert_array_equal(
             out["kernel"][1][rid_k], oracle,
             err_msg="paged-kernel engine diverged from oracle")
+
+
+class TestSpeculativeEngine:
+    """Per-slot speculative decoding composed with continuous
+    batching: tokens must EXACTLY match the plain engine (and the
+    single-stream oracle) regardless of the draft's quality — the
+    draft only moves throughput, never content."""
+
+    def _drive(self, setup, draft_params, k, seed=13):
+        from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+        cfg, model, params = setup
+        rng = np.random.default_rng(seed)
+        prompts = [
+            rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in (5, 9, 7)
+        ]
+        budgets = [6, 20, 9]
+        eng = SpeculativeBatchingEngine(
+            model, params, draft_params, n_slots=2, k=k)
+        rids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+        out = eng.run()
+        for rid, p, b in zip(rids, prompts, budgets):
+            np.testing.assert_array_equal(
+                out[rid], _oracle(model, params, p, b),
+                err_msg=f"request {rid} diverged from oracle",
+            )
+        return eng
+
+    def test_perfect_draft_accepts_everything(self, setup):
+        """Draft == target: every proposal must be accepted and the
+        per-round bonus makes k+1 tokens/round the steady state."""
+        _, _, params = setup
+        eng = self._drive(setup, params, k=3)
+        assert eng.stats["acceptance_rate"] == 1.0
+        assert eng.stats["rounds"] > 0
+
+    def test_bad_draft_still_exact(self, setup):
+        """A draft with perturbed weights mostly disagrees: rounds
+        degenerate toward one token each, but outputs stay exact."""
+        cfg, model, params = setup
+        noisy = jax.tree.map(
+            lambda x: x + 0.3 * jax.random.normal(
+                jax.random.PRNGKey(99), x.shape, x.dtype)
+            if x.ndim >= 2 else x,
+            params,
+        )
+        eng = self._drive(setup, noisy, k=3)
+        assert eng.stats["acceptance_rate"] < 1.0
+
+    def test_int8_draft_and_stats(self, setup):
+        """The intended production draft: int8 tree of the same
+        weights (models/quant.py), high acceptance, exact output."""
+        import dataclasses as dc
+
+        from sparkdl_tpu.models.llama import Llama
+        from sparkdl_tpu.models.quant import quantize_llama_params
+        from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+        cfg, model, params = setup
+        q_params = quantize_llama_params(params)
+        draft = Llama(dc.replace(cfg, quant="int8"))
+        rng = np.random.default_rng(17)
+        p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+        eng = SpeculativeBatchingEngine(
+            model, params, q_params, n_slots=2, k=4, draft_model=draft)
+        rid = eng.submit(p, 12)
+        out = eng.run()
+        np.testing.assert_array_equal(
+            out[rid], _oracle(model, params, p, 12))
+        assert 0.0 <= eng.stats["acceptance_rate"] <= 1.0
+
+    def test_capacity_guard_includes_spec_scratch(self, setup):
+        from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+        cfg, model, params = setup
+        eng = SpeculativeBatchingEngine(model, params, params,
+                                        n_slots=2, k=4)
+        p = np.zeros((5,), np.int32)
+        with pytest.raises(ValueError, match="speculation"):
+            eng.submit(p, cfg.max_cache_len - 5)  # fits without k only
