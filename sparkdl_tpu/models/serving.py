@@ -800,10 +800,15 @@ class ContinuousBatchingEngine:
 
 
 @functools.lru_cache(maxsize=8)
-def _spec_engine_programs(dec_cfg, draft_cfg, k):
+def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature):
     """(draft_prefill, draft_insert, spec_round) — jitted once per
-    (target config, draft config, k)."""
+    (target config, draft config, k, temperature). temperature == 0:
+    greedy longest-agreeing-prefix acceptance (token-exact vs plain
+    greedy decode). temperature > 0: distribution-exact rejection
+    sampling (models/speculative.spec_sample_tokens) — marginals equal
+    target-only sampling, the draft moves only throughput."""
     from sparkdl_tpu.models.llama import Llama
+    from sparkdl_tpu.models.speculative import spec_sample_tokens
 
     target = Llama(dec_cfg)
     draft = Llama(draft_cfg)
@@ -828,28 +833,38 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k):
 
     @functools.partial(jax.jit, donate_argnums=(1, 3))
     def spec_round(params, cache, d_params, d_cache, token, pos,
-                   active):
+                   active, rng):
         """One speculation round over every slot: the draft scans k
-        greedy slot-mapped steps, then ONE target forward scores the
-        k+1 positions. Rejected rows above each slot's accepted
-        position are junk that the NEXT round's writes cover before
-        any query can see them (write window [pos', pos'+k] always
-        spans the previous round's junk because pos advances by at
-        most k+1)."""
+        slot-mapped steps, then ONE target forward scores the k+1
+        positions, and acceptance runs IN-GRAPH — the host reads back
+        only (tokens, counts). Rejected rows above each slot's
+        accepted position are junk that the NEXT round's writes cover
+        before any query can see them (write window [pos', pos'+k]
+        always spans the previous round's junk because pos advances
+        by at most k+1)."""
         L = dec_cfg.max_cache_len
+        rng, d_rng = jax.random.split(rng)
 
-        def body(carry, _):
+        def body(carry, step_rng):
             d_cache, tok, p = carry
             logits, st = draft.apply(
                 {"params": d_params, "cache": d_cache}, tok[:, None],
                 positions=p[:, None], mutable=["cache"],
             )
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            last = logits[:, -1]
+            if temperature == 0.0:
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                q_row = jnp.zeros_like(last)  # unused in greedy
+            else:
+                q_row = jax.nn.softmax(last / temperature, axis=-1)
+                nxt = jax.random.categorical(
+                    step_rng, last / temperature, axis=-1
+                ).astype(jnp.int32)
             p = jnp.where(active, jnp.minimum(p + 1, L - 1), p)
-            return (st["cache"], nxt, p), nxt
+            return (st["cache"], nxt, p), (nxt, q_row)
 
-        (d_cache, last_tok, last_p), prop = jax.lax.scan(
-            body, (d_cache, token, pos), None, length=k)
+        (d_cache, last_tok, last_p), (prop, q_probs) = jax.lax.scan(
+            body, (d_cache, token, pos), jax.random.split(d_rng, k))
         # one extra logits-discarded step writes the LAST proposal's
         # K/V row: a fully-accepted round advances past it, and
         # without this write the draft's next round attends a junk
@@ -871,8 +886,22 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k):
             {"params": params, "cache": cache}, seq, positions=ppos,
             mutable=["cache"],
         )
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return st["cache"], d_cache, prop, greedy         # (b, k+1)
+        if temperature == 0.0:
+            from sparkdl_tpu.models.speculative import assemble_round
+
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            agree = prop == greedy[:, :k]
+            all_acc = agree.all(-1)
+            m = jnp.where(all_acc, k, jnp.argmin(agree, -1))
+            final = jnp.take_along_axis(
+                greedy, m[:, None], axis=1)[:, 0]
+            tokens, counts = assemble_round(prop, m, final)
+        else:
+            rng, s_rng = jax.random.split(rng)
+            p_probs = jax.nn.softmax(logits / temperature, axis=-1)
+            tokens, counts = spec_sample_tokens(
+                q_probs.transpose(1, 0, 2), p_probs, prop, s_rng)
+        return st["cache"], d_cache, tokens, counts, rng
 
     return draft_prefill, draft_insert, spec_round
 
@@ -886,12 +915,19 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     identity per slot; no lockstep barrier like
     :func:`speculative_generate`'s whole-batch agree).
 
-    v1 scope (raises otherwise): dense slot cache (no paging), greedy
-    (temperature 0), single adapter, no prefix caching, no TP mesh.
+    ``temperature > 0`` switches the round to distribution-exact
+    rejection sampling (:func:`~sparkdl_tpu.models.speculative.
+    spec_sample_tokens`): accept proposal x with prob min(1, p(x)/q(x)),
+    resample the first rejection from the residual (p-q)+ — marginals
+    equal target-only sampling; the draft moves only throughput.
+
+    v1 scope (raises otherwise): dense slot cache (no paging), single
+    adapter, no prefix caching, no TP mesh.
     """
 
     def __init__(self, model, params, draft_params, *, n_slots=4,
-                 eos_id=None, k=4, rng=None, draft_model=None):
+                 eos_id=None, k=4, rng=None, draft_model=None,
+                 temperature=0.0):
         cfg = model.cfg
         if cfg.page_size:
             raise ValueError(
@@ -900,7 +936,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             raise ValueError(
                 "SpeculativeBatchingEngine v1 is single-adapter only")
         super().__init__(model, params, n_slots=n_slots,
-                         temperature=0.0, eos_id=eos_id, rng=rng)
+                         temperature=temperature, eos_id=eos_id,
+                         rng=rng)
         self.k = int(k)
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
@@ -921,7 +958,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     @property
     def _spec_programs(self):
-        return _spec_engine_programs(self.cfg, self._draft_cfg, self.k)
+        return _spec_engine_programs(self.cfg, self._draft_cfg, self.k,
+                                     self.temperature)
 
     def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
                adapter_id=0):
@@ -970,13 +1008,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             active = np.array([s.active for s in self._slots])
             if not active.any():
                 continue
-            (self._cache, self._d_cache, prop, greedy) = spec_round(
+            (self._cache, self._d_cache, tokens, counts,
+             self._rng) = spec_round(
                 self.params, self._cache, self.draft_params,
                 self._d_cache, self._token, self._pos,
-                jnp.asarray(active),
+                jnp.asarray(active), self._rng,
             )
-            prop = np.asarray(prop)                   # (b, k)
-            greedy = np.asarray(greedy)               # (b, k+1)
+            tokens = np.asarray(tokens)               # (b, k+1)
+            counts = np.asarray(counts)               # (b,)
             n_act = int(active.sum())
             self.stats["rounds"] += 1
             self.stats["proposed"] += self.k * n_act
@@ -988,14 +1027,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             for i, s in enumerate(self._slots):
                 if not s.active:
                     continue
-                agree = prop[i] == greedy[i, :self.k]
-                m = (int(np.argmin(agree)) if not agree.all()
-                     else self.k)
-                self.stats["accepted"] += m
-                accepted = list(prop[i, :m]) + [greedy[i, m]]
-                if not self._accept_tokens(i, accepted):
-                    new_pos[i] += m + 1
-                    new_tok[i] = greedy[i, m]
+                cnt = int(counts[i])
+                # cnt-1 proposals survived; the last token is the
+                # bonus (full acceptance) or the corrected/resampled
+                # one (first rejection)
+                self.stats["accepted"] += cnt - 1
+                if not self._accept_tokens(i, tokens[i, :cnt]):
+                    new_pos[i] += cnt
+                    new_tok[i] = tokens[i, cnt - 1]
             self._pos = jnp.asarray(new_pos)
             self._token = jnp.asarray(new_tok)
             if progress is not None:

@@ -182,3 +182,64 @@ def speculative_generate(model, params, draft_params, prompt_tokens, *,
 
     toks = jnp.asarray(np.stack(new, axis=1), jnp.int32)  # (B, n)
     return jnp.concatenate([prompt_tokens, toks], axis=1), stats
+
+
+def assemble_round(proposals, m, final):
+    """Pack a speculation round's output: row b's tokens are
+    ``proposals[b, :m[b]]`` then ``final[b]`` (bonus or correction),
+    padded with zeros; counts = m+1. ONE definition shared by the
+    greedy and sampling acceptance paths."""
+    b, k = proposals.shape
+    idx = jnp.arange(k + 1)[None]
+    padded = jnp.pad(proposals, ((0, 0), (0, 1)))
+    tokens = jnp.where(
+        idx < m[:, None], padded,
+        jnp.where(idx == m[:, None], final[:, None], 0),
+    ).astype(jnp.int32)
+    return tokens, m + 1
+
+
+def spec_sample_tokens(q_probs, p_probs, proposals, rng):
+    """Distribution-exact speculative ACCEPT/RESAMPLE (the sampling
+    counterpart of the greedy longest-agreeing-prefix rule; Leviathan
+    et al.'s rejection scheme). Pure function so the math is unit-
+    testable against analytic marginals.
+
+    Args:
+      q_probs: (B, k, V) draft distributions at each proposal step.
+      p_probs: (B, k+1, V) target distributions at the k+1 verified
+        positions.
+      proposals: (B, k) tokens the draft sampled (from q_probs).
+      rng: PRNG key.
+    Returns ``(tokens (B, k+1), counts (B,))``: row b's first
+    ``counts[b]`` tokens are the round's output — accepted proposals
+    followed by one resampled (on rejection, from the residual
+    ``max(p-q, 0)``) or bonus (full acceptance, from the k+1-th
+    target distribution) token. Marginals equal target-only sampling
+    exactly; the draft moves only the acceptance rate.
+    """
+    b, k, _v = q_probs.shape
+    rng_u, rng_r, rng_b = jax.random.split(rng, 3)
+    px = jnp.take_along_axis(
+        p_probs[:, :k], proposals[..., None], -1)[..., 0]   # (B, k)
+    qx = jnp.take_along_axis(
+        q_probs, proposals[..., None], -1)[..., 0]
+    u = jax.random.uniform(rng_u, (b, k))
+    accept = u * qx < px        # u < p(x)/q(x); q(x) > 0 (x ~ q)
+    all_acc = accept.all(-1)
+    m = jnp.where(all_acc, k, jnp.argmin(accept, -1))       # (B,)
+    # residual distribution at the first rejected position (index
+    # clamped for the gather; unused on full acceptance)
+    mc = jnp.minimum(m, k - 1)
+    p_m = jnp.take_along_axis(p_probs, mc[:, None, None], 1)[:, 0]
+    q_m = jnp.take_along_axis(q_probs, mc[:, None, None], 1)[:, 0]
+    resid = jnp.maximum(p_m - q_m, 0.0)
+    # all-zero residual has probability 0 (it needs p<=q everywhere,
+    # which makes rejection impossible); the floor only guards NaNs
+    resid = resid / jnp.maximum(resid.sum(-1, keepdims=True), 1e-30)
+    resampled = jax.random.categorical(
+        rng_r, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1)
+    bonus = jax.random.categorical(
+        rng_b, jnp.log(jnp.maximum(p_probs[:, k], 1e-30)), axis=-1)
+    final = jnp.where(all_acc, bonus, resampled).astype(jnp.int32)
+    return assemble_round(proposals, m, final)

@@ -658,3 +658,53 @@ class TestSpeculativeEngine:
         p = np.zeros((5,), np.int32)
         with pytest.raises(ValueError, match="speculation"):
             eng.submit(p, cfg.max_cache_len - 5)  # fits without k only
+
+
+def test_speculative_engine_sampling_mode(setup):
+    """temperature > 0: rejection-sampling rounds (distribution
+    exactness is pinned analytically in test_spec_sampling.py; here
+    the ENGINE plumbing — budgets, vocab range, stats — must hold)."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(23)
+    eng = SpeculativeBatchingEngine(
+        model, params, params, n_slots=2, k=3, temperature=0.8)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 8)]
+    rids = [eng.submit(p, 10) for p in prompts]
+    out = eng.run()
+    for rid in rids:
+        assert len(out[rid]) == 10
+        assert (out[rid] >= 0).all() and (out[rid] < cfg.vocab_size).all()
+    # identical draft: acceptance is min(1, p/q)=1 pointwise.
+    # >= rather than ==: p and q come from DIFFERENT XLA programs
+    # (1-token draft steps vs the k+1 verify), and the strict u*q < p
+    # test can lose to a one-ulp rounding gap on some backends.
+    assert eng.stats["acceptance_rate"] >= 0.95
+
+
+def test_speculative_engine_sampling_with_rejections(setup):
+    """Perturbed draft at temperature > 0: the in-engine rejection /
+    residual-resample path (cnt < k+1 through _run's bookkeeping)
+    must hold budgets and produce in-vocab tokens."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    noisy = jax.tree.map(
+        lambda x: x + 0.3 * jax.random.normal(
+            jax.random.PRNGKey(5), x.shape, x.dtype)
+        if x.ndim >= 2 else x,
+        params,
+    )
+    rng = np.random.default_rng(29)
+    eng = SpeculativeBatchingEngine(
+        model, params, noisy, n_slots=2, k=3, temperature=0.8)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 8, 6)]
+    rids = [eng.submit(p, 12) for p in prompts]
+    out = eng.run()
+    for rid in rids:
+        assert len(out[rid]) == 12
+        assert (out[rid] >= 0).all() and (out[rid] < cfg.vocab_size).all()
+    assert eng.stats["acceptance_rate"] < 1.0  # rejections happened

@@ -1,0 +1,67 @@
+"""spec_sample_tokens math: the output marginal must equal TARGET-only
+sampling regardless of the draft — the speculative-sampling theorem,
+checked empirically against the analytic distribution on a toy vocab."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.models.speculative import spec_sample_tokens
+
+
+def test_first_token_marginal_is_exactly_target():
+    V, k, trials = 6, 2, 40_000
+    rng = np.random.default_rng(0)
+    q0 = jax.nn.softmax(jnp.asarray(rng.standard_normal(V)) * 1.5)
+    p0 = jax.nn.softmax(jnp.asarray(rng.standard_normal(V)) * 1.5)
+    # step-2 distributions don't affect the FIRST token's marginal
+    q_probs = jnp.stack([q0, q0])[None].repeat(trials, 0)   # (T,k,V)
+    p_probs = jnp.stack([p0, p0, p0])[None].repeat(trials, 0)
+
+    key = jax.random.PRNGKey(42)
+    kp, ks = jax.random.split(key)
+    proposals = jax.random.categorical(
+        kp, jnp.log(q_probs), axis=-1)                      # (T,k) ~ q
+    tokens, counts = jax.jit(spec_sample_tokens)(
+        q_probs, p_probs, proposals, ks)
+    first = np.asarray(tokens[:, 0])
+    hist = np.bincount(first, minlength=V) / trials
+    np.testing.assert_allclose(hist, np.asarray(p0), atol=0.015)
+    # acceptance rate matches the analytic sum(min(p, q))
+    overlap = float(jnp.minimum(p0, q0).sum())
+    acc1 = float((np.asarray(counts) >= 2).mean())  # pos-0 accepted
+    assert abs(acc1 - overlap) < 0.02, (acc1, overlap)
+
+
+def test_identical_draft_accepts_everything():
+    V, k, b = 8, 3, 512
+    rng = np.random.default_rng(1)
+    p = jax.nn.softmax(jnp.asarray(rng.standard_normal((b, k + 1, V))))
+    q = p[:, :k]
+    key = jax.random.PRNGKey(7)
+    kp, ks = jax.random.split(key)
+    proposals = jax.random.categorical(kp, jnp.log(q), axis=-1)
+    tokens, counts = spec_sample_tokens(q, p, proposals, ks)
+    # p == q => accept prob min(1, p/q) = 1 at the proposed token
+    assert (np.asarray(counts) == k + 1).all()
+    np.testing.assert_array_equal(
+        np.asarray(tokens[:, :k]), np.asarray(proposals))
+
+
+def test_disjoint_draft_rejects_first():
+    """Draft puts all mass where target has (almost) none: everything
+    is rejected at position 0 and the resample comes from the
+    residual ~= p."""
+    V, k, trials = 4, 2, 20_000
+    p0 = jnp.asarray([0.5, 0.5, 0.0, 0.0])
+    q0 = jnp.asarray([0.0, 0.0, 0.5, 0.5])
+    q_probs = jnp.stack([q0, q0])[None].repeat(trials, 0)
+    p_probs = jnp.stack([p0, p0, p0])[None].repeat(trials, 0)
+    key = jax.random.PRNGKey(3)
+    kp, ks = jax.random.split(key)
+    proposals = jax.random.categorical(kp, jnp.log(q_probs + 1e-30),
+                                       axis=-1)
+    tokens, counts = spec_sample_tokens(q_probs, p_probs, proposals, ks)
+    assert (np.asarray(counts) == 1).all()
+    hist = np.bincount(np.asarray(tokens[:, 0]), minlength=V) / trials
+    np.testing.assert_allclose(hist, np.asarray(p0), atol=0.015)
