@@ -2,13 +2,17 @@
 
 Beyond-reference capability (the reference scales data only, SURVEY.md
 §2.3): a top-k routed expert MLP whose stacked expert weights shard
-over an ``expert`` mesh axis. Execution model (psum-combine EP): every
-device computes its LOCAL experts for all tokens and the gate-weighted
-partial outputs are psum'd over the expert axis — expert weights (the
-dominant memory) are fully sharded, while activations trade one psum
-for the all-to-all of dispatch-based MoE (the bandwidth-optimal
-dispatch path can swap in behind the same module later; the weight
-sharding and routing semantics are what the rest of the stack sees).
+over an ``expert`` mesh axis, with TWO execution models behind the
+same routing semantics:
+
+- psum-combine (:func:`expert_parallel_moe`): every device computes
+  its LOCAL experts for all replicated tokens; partial outputs psum.
+  Simple, fine at small expert counts — but FLOPs scale with
+  n_experts x all tokens.
+- all_to_all dispatch (:func:`expert_parallel_moe_a2a`): tokens ride
+  the ICI to their expert's shard in fixed-capacity buffers
+  (Switch/Mixtral execution model) — FLOPs scale with capacity, the
+  sparse-MoE point.
 """
 
 import dataclasses
@@ -17,6 +21,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +138,28 @@ def moe_apply(x, gates, w_gate, w_up, w_down, axis_name=None):
     return combined
 
 
+def _expert_axis_size(mesh, cfg, axis_name):
+    """Shard count on ``axis_name`` + the divisibility guard shared by
+    both expert-parallel execution models."""
+    n_shards = dict(mesh.shape)[axis_name]
+    if cfg.n_experts % n_shards:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by the "
+            f"{axis_name} axis ({n_shards})"
+        )
+    return n_shards
+
+
+def _expert_param_specs(axis_name):
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router": {"kernel": P(), "bias": P()},
+        "w_gate": P(axis_name), "w_up": P(axis_name),
+        "w_down": P(axis_name),
+    }
+
+
 def expert_parallel_moe(mesh, cfg, *, axis_name="expert"):
     """Bind an expert-parallel MoE forward to a mesh: returns
     ``f(params, x)`` on GLOBAL arrays where the stacked expert weights
@@ -143,12 +170,7 @@ def expert_parallel_moe(mesh, cfg, *, axis_name="expert"):
     """
     from jax.sharding import PartitionSpec as P
 
-    n_exp_shards = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
-    if cfg.n_experts % n_exp_shards:
-        raise ValueError(
-            f"n_experts={cfg.n_experts} not divisible by the "
-            f"{axis_name} axis ({n_exp_shards})"
-        )
+    n_exp_shards = _expert_axis_size(mesh, cfg, axis_name)
 
     def local_fn(params, x):
         shard = jax.lax.axis_index(axis_name)
@@ -167,12 +189,91 @@ def expert_parallel_moe(mesh, cfg, *, axis_name="expert"):
             params["w_down"], axis_name=axis_name,
         )
 
-    param_specs = {
-        "router": {"kernel": P(), "bias": P()},
-        "w_gate": P(axis_name), "w_up": P(axis_name),
-        "w_down": P(axis_name),
-    }
     return jax.shard_map(
-        local_fn, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+        local_fn, mesh=mesh,
+        in_specs=(_expert_param_specs(axis_name), P()), out_specs=P(),
+        check_vma=False,
+    )
+
+
+def expert_parallel_moe_a2a(mesh, cfg, *, axis_name="expert",
+                            capacity_factor=1.25):
+    """Dispatch-based expert parallelism: tokens ride ``all_to_all`` to
+    the shard holding their expert (Switch/Mixtral execution model),
+    so expert FLOPs scale with CAPACITY, not with
+    n_experts x all-tokens like the psum-combine path
+    (:func:`expert_parallel_moe`, which computes every local expert on
+    every replicated token — fine at small expert counts, wasteful at
+    scale).
+
+    Per shard: route local tokens, pack each expert's selections into
+    a fixed CAPACITY buffer (``C = ceil(tokens_local * top_k / E *
+    capacity_factor)``; overflow tokens are DROPPED for that expert —
+    their gate contribution becomes zero, the standard capacity
+    trade), all_to_all the (E, C, d) buffers so each shard receives
+    its own experts' tokens from every shard, run the expert SwiGLU on
+    exactly those tokens, all_to_all back, and gate-combine.
+
+    Returns ``f(params, x)`` on GLOBAL arrays: x sharded over tokens
+    on ``axis_name`` (leading axis), expert weights sharded over
+    ``axis_name``, router replicated — same param tree as
+    :class:`MoEMLP`.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = _expert_axis_size(mesh, cfg, axis_name)
+    e_local = cfg.n_experts // n_shards
+
+    def local_fn(params, x):
+        d = x.shape[-1]
+        lead = x.shape[:-1]
+        xt = x.reshape(-1, d)                        # (T_local, d)
+        T = xt.shape[0]
+        E = cfg.n_experts
+        # static per-expert buffer size: the a2a and expert matmuls
+        # have fixed shapes regardless of where the router sends load
+        C = max(1, int(np.ceil(T * cfg.top_k / E * capacity_factor)))
+        logits = (
+            xt.astype(jnp.float32) @ params["router"]["kernel"]
+            + params["router"]["bias"]
+        )
+        gates = moe_gates(logits, cfg.top_k)            # (T, E) f32
+        sel = (gates > 0).astype(jnp.int32)
+        # per-expert slot index of each selected token, in token order
+        pos = jnp.cumsum(sel, axis=0) - 1               # (T, E)
+        keep = (sel == 1) & (pos < C)
+        # dispatch tensor (T, E, C): one-hot slot per kept pair
+        disp = (jax.nn.one_hot(pos, C, dtype=xt.dtype)
+                * keep[..., None].astype(xt.dtype))
+        buf = jnp.einsum("tec,td->ecd", disp, xt)       # (E, C, d)
+        # exchange: shard s sends experts [s*e_local, (s+1)*e_local) of
+        # every OTHER shard's buffer and receives its own experts'
+        # buffers from all shards (split/concat on the expert axis)
+        recv = jax.lax.all_to_all(
+            buf, axis_name, split_axis=0, concat_axis=0, tiled=True,
+        )                                               # (E, C, d) =
+        # (n_shards * e_local, C, d) grouped [shard0's e_local, ...]
+        tok_e = (recv.reshape(n_shards, e_local, C, d)
+                 .transpose(1, 0, 2, 3)
+                 .reshape(e_local, n_shards * C, d))
+        h = jnp.einsum("ecd,edf->ecf", tok_e, params["w_gate"])
+        u = jnp.einsum("ecd,edf->ecf", tok_e, params["w_up"])
+        out_e = jnp.einsum(
+            "ecf,efd->ecd", nn.silu(h) * u, params["w_down"]
+        )                                               # (e_local, SC, d)
+        back = (out_e.reshape(e_local, n_shards, C, d)
+                .transpose(1, 0, 2, 3)
+                .reshape(E, C, d))
+        out_buf = jax.lax.all_to_all(
+            back, axis_name, split_axis=0, concat_axis=0, tiled=True,
+        )                                               # (E, C, d) home
+        combine = disp * gates.astype(xt.dtype)[..., None]
+        y = jnp.einsum("tec,ecd->td", combine, out_buf)
+        return y.reshape(*lead, d)
+
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(_expert_param_specs(axis_name), P(axis_name)),
+        out_specs=P(axis_name),
         check_vma=False,
     )
