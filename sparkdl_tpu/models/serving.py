@@ -504,7 +504,7 @@ class ContinuousBatchingEngine:
         rid, prompt, max_new, prefix_id, adapter_id = self._queue[0]
         P = self.page_size
         p_len = len(prompt)
-        total_pages = -(-(p_len + max_new) // P)
+        total_pages = -(-self._worst_case_tokens(p_len, max_new) // P)
         # no-prefix admission = the empty-prefix special case: zero
         # shared pages, zero-length start, the whole prompt as suffix
         prefix = np.zeros((0,), np.int32)
@@ -608,11 +608,18 @@ class ContinuousBatchingEngine:
         must agree with _try_admit_paged or it cries exhaustion over
         requests that would admit)."""
         _, prompt, max_new, prefix_id, _aid = req
-        total = -(-(len(prompt) + max_new) // self.page_size)
+        total = -(-self._worst_case_tokens(len(prompt), max_new)
+                  // self.page_size)
         if prefix_id is not None:
             prefix, _, _pfx = self._prefixes[prefix_id]
             total -= len(prefix) // self.page_size
         return total
+
+    def _worst_case_tokens(self, p_len, max_new):
+        """Cache rows a request can ever touch — page reservation AND
+        the pool dead-end check size worst cases with this ONE hook
+        (the speculative engine adds its k-token verify scratch)."""
+        return p_len + max_new
 
     def _activate_slot(self, slot_idx, rid, max_new, tok):
         """Shared admission epilogue: slot bookkeeping + the
@@ -697,34 +704,9 @@ class ContinuousBatchingEngine:
                or any(s.active for s in self._slots)):
             # fill free slots from the queue (paged: only while the
             # pool covers the next request's worst case)
-            for i, s in enumerate(self._slots):
-                if (not s.active and i not in self._prefilling
-                        and self._queue):
-                    if self.page_size:
-                        if not self._try_admit_paged(i):
-                            break
-                    else:
-                        self._admit(i)
-            # one prefill segment per staged slot per iteration:
-            # long-prompt admission interleaves with decode instead of
-            # stalling it for the whole prompt
-            for i in list(self._prefilling):
-                self._advance_prefill(i)
-            active = np.array([s.active for s in self._slots])
+            active = self._fill_slots()
             if not active.any():
-                if self._queue and self.page_size \
-                        and not self._prefilling:
-                    need = self._pages_needed(self._queue[0])
-                    # only a GENUINE shortfall is a dead end: an
-                    # instantly-finished admission (eos/one-token
-                    # budget) also lands here, with pages free again
-                    if need > len(self._free_pages):
-                        raise RuntimeError(
-                            f"paged pool exhausted: request needs "
-                            f"{need} fresh pages, pool has "
-                            f"{len(self._free_pages)} free and nothing "
-                            "left to drain — raise n_pages"
-                        )
+                self._deadend_check()
                 continue
             # Chunk length: sized to the soonest-finishing active slot
             # (so its replacement isn't kept waiting), then rounded UP
@@ -762,6 +744,40 @@ class ContinuousBatchingEngine:
             if progress is not None:
                 progress(self)
         return self._drain_results()
+
+    def _fill_slots(self):
+        """Admit queued requests into free slots (paged: only while the
+        pool covers worst cases), advance any staged chunked prefills,
+        and return the active mask. Shared by both decode loops."""
+        for i, s in enumerate(self._slots):
+            if (not s.active and i not in self._prefilling
+                    and self._queue):
+                if self.page_size:
+                    if not self._try_admit_paged(i):
+                        break
+                else:
+                    self._admit(i)
+        # one prefill segment per staged slot per iteration:
+        # long-prompt admission interleaves with decode instead of
+        # stalling it for the whole prompt
+        for i in list(self._prefilling):
+            self._advance_prefill(i)
+        return np.array([s.active for s in self._slots])
+
+    def _deadend_check(self):
+        """Nothing active: raise when the queue head can NEVER admit
+        (genuine pool shortfall) rather than spinning forever — an
+        instantly-finished admission (eos / one-token budget) also
+        lands here, with pages free again, and is not a dead end."""
+        if self._queue and self.page_size and not self._prefilling:
+            need = self._pages_needed(self._queue[0])
+            if need > len(self._free_pages):
+                raise RuntimeError(
+                    f"paged pool exhausted: request needs "
+                    f"{need} fresh pages, pool has "
+                    f"{len(self._free_pages)} free and nothing "
+                    "left to drain — raise n_pages"
+                )
 
     def _accept_tokens(self, slot_idx, tokens):
         """Append generated tokens to a slot (streaming callback, eos
@@ -833,7 +849,7 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature):
 
     @functools.partial(jax.jit, donate_argnums=(1, 3))
     def spec_round(params, cache, d_params, d_cache, token, pos,
-                   active, rng):
+                   active, rng, tables=None):
         """One speculation round over every slot: the draft scans k
         slot-mapped steps, then ONE target forward scores the k+1
         positions, and acceptance runs IN-GRAPH — the host reads back
@@ -884,7 +900,7 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature):
         seq = jnp.concatenate([token[:, None], prop], axis=1)
         logits, st = target.apply(
             {"params": params, "cache": cache}, seq, positions=ppos,
-            mutable=["cache"],
+            block_tables=tables, mutable=["cache"],
         )
         if temperature == 0.0:
             from sparkdl_tpu.models.speculative import assemble_round
@@ -921,26 +937,31 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     resample the first rejection from the residual (p-q)+ — marginals
     equal target-only sampling; the draft moves only throughput.
 
-    v1 scope (raises otherwise): dense slot cache (no paging), single
-    adapter, no prefix caching, no TP mesh.
+    The TARGET cache may be paged (``page_size=``): verify writes ride
+    the slot's block table, and page reservation adds the k-token
+    scratch via :meth:`_worst_case_tokens`. The DRAFT always keeps a
+    dense slot cache — proposals are the draft's problem, and a dense
+    (typically int8) draft cache is simpler than a second page pool.
+
+    Out of scope (raises): multi-adapter, prefix caching, chunked
+    prefill, TP mesh.
     """
 
     def __init__(self, model, params, draft_params, *, n_slots=4,
                  eos_id=None, k=4, rng=None, draft_model=None,
-                 temperature=0.0):
+                 temperature=0.0, page_size=0, n_pages=None):
         cfg = model.cfg
-        if cfg.page_size:
-            raise ValueError(
-                "SpeculativeBatchingEngine v1 is dense-cache only")
         if cfg.multi_lora:
             raise ValueError(
-                "SpeculativeBatchingEngine v1 is single-adapter only")
-        super().__init__(model, params, n_slots=n_slots,
-                         temperature=temperature, eos_id=eos_id,
-                         rng=rng)
+                "SpeculativeBatchingEngine is single-adapter only")
+        # set before super(): _worst_case_tokens (k-dependent) is live
+        # as soon as the base class can admit
         self.k = int(k)
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        super().__init__(model, params, n_slots=n_slots,
+                         temperature=temperature, eos_id=eos_id,
+                         rng=rng, page_size=page_size, n_pages=n_pages)
         d_base = draft_model.cfg if draft_model is not None else cfg
         self._draft_cfg = dataclasses.replace(
             d_base, decode=True, max_cache_len=self.cfg.max_cache_len,
@@ -961,18 +982,21 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         return _spec_engine_programs(self.cfg, self._draft_cfg, self.k,
                                      self.temperature)
 
+    def _worst_case_tokens(self, p_len, max_new):
+        # + k scratch: a verify may write k positions past the final
+        # accepted token; those rows (and, paged, their pages) must be
+        # the request's OWN scratch, never a neighbour's data.
+        return p_len + max_new + self.k
+
     def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
                adapter_id=0):
         if prefix_id is not None:
             raise ValueError(
-                "SpeculativeBatchingEngine v1 has no prefix caching "
+                "SpeculativeBatchingEngine has no prefix caching "
                 "(the draft would need its own prefix cache)")
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
-        # + k scratch: a verify may write k positions past the final
-        # accepted token; the in-kernel clamp keeps writes in bounds
-        # but exactness needs rows past the budget to be SCRATCH, not
-        # a neighbour's data — so the whole window must fit.
-        if len(prompt) + max_new_tokens + self.k > self.cfg.max_cache_len:
+        if self._worst_case_tokens(len(prompt), max_new_tokens) \
+                > self.cfg.max_cache_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) + k ({self.k}) speculation "
@@ -983,10 +1007,27 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         return super().submit(prompt, max_new_tokens,
                               adapter_id=adapter_id)
 
-    def _admit(self, slot_idx):
-        # capture before super() pops the queue head
-        _, prompt, _, _, _ = self._queue[0]
-        super()._admit(slot_idx)
+    def register_prefix(self, prefix_tokens, adapter_id=0):
+        raise ValueError(
+            "SpeculativeBatchingEngine has no prefix caching (the "
+            "draft would need its own prefix cache); on a paged "
+            "engine a stray registration would also permanently "
+            "lease pool pages no submit() could ever use"
+        )
+
+    def _draft_admit(self, slot_idx, prompt):
+        """Prompt through the draft into its dense slot cache —
+        shared epilogue of both admission paths."""
+        if slot_idx in self._prefilling:
+            # chunked prefill STAGES the slot inactive; the early
+            # return below would then skip the draft prefill and this
+            # request would speculate against the previous occupant's
+            # draft K/V (silent acceptance collapse) — fail fast
+            # instead. __init__ never enables prefill_chunk; this
+            # guards future plumbing.
+            raise RuntimeError(
+                "speculative engine does not support chunked prefill"
+            )
         if not self._slots[slot_idx].active:
             # instantly finished (first token was eos / 1-token
             # budget): the slot will be re-admitted fresh — don't pay
@@ -999,20 +1040,35 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         one = draft_prefill(self.draft_params, jnp.asarray(padded))
         self._d_cache = draft_insert(self._d_cache, one, slot_idx)
 
+    def _admit(self, slot_idx):
+        # capture before super() pops the queue head
+        _, prompt, _, _, _ = self._queue[0]
+        super()._admit(slot_idx)
+        self._draft_admit(slot_idx, prompt)
+
+    def _try_admit_paged(self, slot_idx):
+        _, prompt, _, _, _ = self._queue[0]
+        if not super()._try_admit_paged(slot_idx):
+            return False
+        self._draft_admit(slot_idx, prompt)
+        return True
+
     def _run(self, progress):
         _, _, spec_round = self._spec_programs
-        while self._queue or any(s.active for s in self._slots):
-            for i, s in enumerate(self._slots):
-                if not s.active and self._queue:
-                    self._admit(i)
-            active = np.array([s.active for s in self._slots])
+        while (self._queue or self._prefilling
+               or any(s.active for s in self._slots)):
+            active = self._fill_slots()
             if not active.any():
+                self._deadend_check()
                 continue
             (self._cache, self._d_cache, tokens, counts,
              self._rng) = spec_round(
                 self.params, self._cache, self.draft_params,
                 self._d_cache, self._token, self._pos,
                 jnp.asarray(active), self._rng,
+                tables=(jnp.asarray(
+                    np.where(active[:, None], self._tables, 0))
+                        if self.page_size else None),
             )
             tokens = np.asarray(tokens)               # (b, k+1)
             counts = np.asarray(counts)               # (b,)

@@ -708,3 +708,56 @@ def test_speculative_engine_sampling_with_rejections(setup):
         assert len(out[rid]) == 12
         assert (out[rid] >= 0).all() and (out[rid] < cfg.vocab_size).all()
     assert eng.stats["acceptance_rate"] < 1.0  # rejections happened
+
+
+def test_speculative_paged_engine_matches_oracle(setup):
+    """Paged target + dense draft: speculative verify writes ride the
+    block tables (with k-token scratch pages reserved per slot), and
+    greedy tokens must STILL match the single-stream oracle exactly —
+    across page-boundary crossings and slot/page reuse."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(31)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+        for n in (5, 9, 7)
+    ]
+    budgets = [6, 20, 9]  # 20 crosses the 16-token page boundary
+    eng = SpeculativeBatchingEngine(
+        model, params, params, n_slots=2, k=3, page_size=16)
+    rids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+    out = eng.run()
+    for rid, p, b in zip(rids, prompts, budgets):
+        np.testing.assert_array_equal(
+            out[rid], _oracle(model, params, p, b),
+            err_msg=f"paged spec request {rid} diverged from oracle",
+        )
+    # every page returned to the pool after the burst
+    assert len(eng._free_pages) == eng.cfg.n_pages - 1  # minus dump
+
+
+def test_speculative_paged_scratch_reservation(setup):
+    """Page accounting must include the k-token verify scratch: a
+    request whose prompt+budget fits exactly in its pages still needs
+    the extra page the scratch can touch."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    eng = SpeculativeBatchingEngine(
+        model, params, params, n_slots=1, k=4, page_size=16)
+    # 16+16=32 tokens = exactly 2 pages; +k scratch forces a 3rd
+    assert eng._pages_needed(
+        (0, np.zeros(16, np.int32), 16, None, 0)) == 3
+
+
+def test_speculative_engine_rejects_prefix_registration(setup):
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    eng = SpeculativeBatchingEngine(
+        model, params, params, n_slots=2, k=2, page_size=16)
+    free_before = len(eng._free_pages)
+    with pytest.raises(ValueError, match="no prefix caching"):
+        eng.register_prefix(np.arange(1, 9, dtype=np.int32))
+    assert len(eng._free_pages) == free_before  # no pages leased
