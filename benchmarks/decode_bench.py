@@ -85,6 +85,22 @@ def main():
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
+    # int4: quarter the weight bytes — group-wise scales, nibble
+    # unpack in-kernel (decode is bytes-bound; this is the floor)
+    q4_tree = jax.device_put(quantize_llama_params(
+        jax.tree.map(np.asarray, params), bits=4))
+    cfg_q4 = dataclasses.replace(cfg, quant="int4")
+    tps_q4 = measure(Llama(cfg_q4), q4_tree, prompt, new, batch)
+    print(json.dumps({
+        "metric": "llama_decode_int4_tokens_per_sec",
+        "value": round(tps_q4, 1),
+        "unit": "tokens/sec",
+        "batch": batch, "prompt_len": p_len, "new_tokens": new,
+        "vs_bf16": round(tps_q4 / tps, 3),
+        "vs_int8": round(tps_q4 / tps_q, 3),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
     # Speculative decoding: int8 draft proposing for the bf16 target —
     # greedy-exact output; the win is per-round (not per-token) host
     # dispatch plus the draft's halved HBM traffic.

@@ -60,8 +60,9 @@ class LlamaConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Sequence[str] = ("q_proj", "v_proj")
-    quant: str = ""               # "" (dense) | "int8" weight-only serving
-                                  # (params from models.quant.quantize_llama_params)
+    quant: str = ""               # "" (dense) | "int8" | "int4" weight-only
+                                  # serving (params from
+                                  # models.quant.quantize_llama_params)
     # Multi-LoRA serving: > 0 stacks that many adapters on the frozen
     # base (params from models.lora.stack_lora_adapters); adapter_ids
     # passed to __call__ select one per batch row (S-LoRA-style
@@ -124,20 +125,24 @@ class LlamaConfig:
 
 
 def _dense(cfg, features, name):
-    if cfg.quant not in ("", "int8"):
+    if cfg.quant not in ("", "int8", "int4"):
         raise ValueError(
-            f"unknown quant mode {cfg.quant!r}; expected '' or 'int8'"
+            f"unknown quant mode {cfg.quant!r}; expected '', 'int8', "
+            "or 'int4'"
         )
-    if cfg.quant == "int8":
+    if cfg.quant:
         # Serving mode: LoRA must be merged first (merge_lora_with) —
-        # a bf16 adapter over an int8 base is not supported.
+        # a bf16 adapter over a quantized base is not supported.
         if cfg.lora_rank:
             raise ValueError(
-                "quant='int8' requires lora_rank=0 (merge adapters "
-                "with merge_lora_with, then quantize)"
+                f"quant={cfg.quant!r} requires lora_rank=0 (merge "
+                "adapters with merge_lora_with, then quantize)"
             )
-        from sparkdl_tpu.models.quant import QuantDense
+        from sparkdl_tpu.models.quant import QuantDense, QuantDense4
 
+        if cfg.quant == "int4":
+            return QuantDense4(features=features, dtype=cfg.dtype,
+                               name=name)
         return QuantDense(features=features, dtype=cfg.dtype, name=name)
     if cfg.lora_rank and name in cfg.lora_targets:
         return LoRADense(features=features, rank=cfg.lora_rank,
@@ -481,11 +486,12 @@ class Llama(nn.Module):
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         if return_hidden:
             return x
-        if cfg.quant == "int8":
-            from sparkdl_tpu.models.quant import QuantDense
+        if cfg.quant:
+            from sparkdl_tpu.models.quant import QuantDense, QuantDense4
 
-            return QuantDense(cfg.vocab_size, dtype=jnp.float32,
-                              name="lm_head")(x.astype(jnp.float32))
+            head = QuantDense4 if cfg.quant == "int4" else QuantDense
+            return head(cfg.vocab_size, dtype=jnp.float32,
+                        name="lm_head")(x.astype(jnp.float32))
         # fp32 head: stability for the softmax/sampling path. (A bf16
         # head was measured on v5e and did NOT beat this — XLA already
         # runs the fp32 matmul as bf16x3 passes and the extra output

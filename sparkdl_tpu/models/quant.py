@@ -22,8 +22,10 @@ import jax.numpy as jnp
 
 from sparkdl_tpu.ops.pallas.quantized_matmul import (
     DEFAULT_QUANT_TARGETS,
+    INT4_GROUP,
     quantize_params,
     quantized_matmul,
+    quantized_matmul_int4,
 )
 
 # Single source of truth for which Llama layers go int8 (the kernel
@@ -59,9 +61,45 @@ class QuantDense(nn.Module):
         return out.reshape(lead + (self.features,)).astype(self.dtype)
 
 
-def quantize_llama_params(params, targets=LLAMA_QUANT_TARGETS):
-    """Convert a trained (or LoRA-merged) Llama param tree to the int8
-    layout ``Llama(cfg with quant="int8")`` expects. Returns the new
-    tree (bytes-saved bookkeeping is in :func:`quantize_params`)."""
-    q_tree, _ = quantize_params(params, targets=targets)
+class QuantDense4(nn.Module):
+    """Drop-in Dense over nibble-packed int4 weights + group-wise fp32
+    scales (``kernel_q4`` (K//2, N), ``kernel_scale4`` (K//group, N) —
+    the layout :func:`quantize_params` emits at ``bits=4``). Quarter
+    the weight bytes of bf16: decode is HBM-bound, bytes are step
+    time; group scales keep int4's 15 levels usable."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+    group: int = INT4_GROUP
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        w_q = self.param(
+            "kernel_q4",
+            lambda key, shape: jnp.zeros(shape, jnp.int8),
+            (d_in // 2, self.features),
+        )
+        scale = self.param(
+            "kernel_scale4", nn.initializers.ones,
+            (d_in // self.group, self.features),
+        )
+        lead = x.shape[:-1]
+        flat = x.reshape((-1, d_in)).astype(self.dtype)
+        # group inferred from the CHECKPOINT's scale shape (like
+        # dequantize_params): self.group only sizes fresh init — a
+        # tree quantized at a different group must still serve
+        out = quantized_matmul_int4(
+            flat, w_q, scale, group=d_in // scale.shape[0])
+        return out.reshape(lead + (self.features,)).astype(self.dtype)
+
+
+def quantize_llama_params(params, targets=LLAMA_QUANT_TARGETS, bits=8,
+                          group=INT4_GROUP):
+    """Convert a trained (or LoRA-merged) Llama param tree to the
+    layout ``Llama(cfg with quant="int8"/"int4")`` expects. Returns
+    the new tree (bytes-saved bookkeeping is in
+    :func:`quantize_params`)."""
+    q_tree, _ = quantize_params(params, targets=targets, bits=bits,
+                                group=group)
     return q_tree

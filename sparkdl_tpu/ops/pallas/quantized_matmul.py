@@ -14,6 +14,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# int4 group size (rows per scale); defined up top because
+# quantize_params defaults to it
+INT4_GROUP = 64
+
 
 def quantize_int8(w):
     """Per-output-channel symmetric int8 quantization of a (K, N)
@@ -94,10 +98,14 @@ DEFAULT_QUANT_TARGETS = ("gate_proj", "up_proj", "down_proj",
                          "o_proj", "lm_head")
 
 
-def quantize_params(params, targets=DEFAULT_QUANT_TARGETS):
+def quantize_params(params, targets=DEFAULT_QUANT_TARGETS, bits=8,
+                    group=INT4_GROUP):
     """Quantize matching kernel leaves of a flax param tree →
-    (new_params with int8 'kernel_q' + 'kernel_scale', bytes saved)."""
-
+    (new_params, bytes saved). ``bits=8``: per-column int8
+    ('kernel_q' + 'kernel_scale'). ``bits=4``: group-wise nibble-packed
+    int4 ('kernel_q4' + 'kernel_scale4')."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
     saved = [0]
 
     def walk(node, name=""):
@@ -105,15 +113,21 @@ def quantize_params(params, targets=DEFAULT_QUANT_TARGETS):
             if ("kernel" in node and any(t in name for t in targets)
                     and getattr(node["kernel"], "ndim", 0) == 2):
                 orig = node["kernel"]
-                w_q, s = quantize_int8(np.asarray(orig, np.float32))
+                if bits == 8:
+                    w_q, s = quantize_int8(np.asarray(orig, np.float32))
+                    names = ("kernel_q", "kernel_scale")
+                else:
+                    w_q, s = quantize_int4(
+                        np.asarray(orig, np.float32), group=group)
+                    names = ("kernel_q4", "kernel_scale4")
                 # savings accounted against the ORIGINAL dtype (bf16
                 # kernels are 2 bytes/elt, not 4)
                 saved[0] += (
                     np.asarray(orig).nbytes - w_q.nbytes - s.nbytes
                 )
                 out = dict(node)
-                out["kernel_q"] = w_q
-                out["kernel_scale"] = s
+                out[names[0]] = w_q
+                out[names[1]] = s
                 del out["kernel"]
                 return out
             return {k: walk(v, k) for k, v in node.items()}
@@ -139,7 +153,123 @@ def dequantize_params(qparams, dtype=jnp.bfloat16):
                     * jnp.asarray(node["kernel_scale"])[None, :]
                 ).astype(dtype)
                 return out
+            if "kernel_q4" in node:
+                out = {k: v for k, v in node.items()
+                       if k not in ("kernel_q4", "kernel_scale4")}
+                scales = jnp.asarray(node["kernel_scale4"])
+                k_full = 2 * node["kernel_q4"].shape[0]
+                group = k_full // scales.shape[0]
+                out["kernel"] = _dequant_int4(
+                    jnp.asarray(node["kernel_q4"]), scales, group
+                ).astype(dtype)
+                return out
             return {k: walk(v) for k, v in node.items()}
         return node
 
     return walk(qparams)
+
+
+# ---------------------------------------------------------------------------
+# int4 weight-only: two nibbles per int8 byte along K, GROUP-wise
+# scales (finer than int8's per-column — int4's 15 levels need them).
+# Quarter the weight bytes of bf16; decode is HBM-bound, so bytes are
+# step time.
+# ---------------------------------------------------------------------------
+
+
+def quantize_int4(w, group=INT4_GROUP):
+    """Group-wise symmetric int4 quantization of (K, N) →
+    (packed int8 (K//2, N), scales fp32 (K//group, N)). Row 2i rides
+    the LOW nibble of packed row i, row 2i+1 the HIGH nibble."""
+    w = np.asarray(w, np.float32)
+    k, n = w.shape
+    if k % max(group, 2):
+        raise ValueError(f"K={k} must be divisible by group={group} (and 2)")
+    g = w.reshape(k // group, group, n)
+    scales = np.abs(g).max(axis=1) / 7.0              # (K//group, N)
+    scales = np.where(scales == 0.0, 1.0, scales).astype(np.float32)
+    w_q = np.clip(np.round(g / scales[:, None, :]), -7, 7)
+    w_q = w_q.reshape(k, n).astype(np.int8)
+    low = w_q[0::2].astype(np.uint8) & 0x0F
+    high = (w_q[1::2].astype(np.uint8) & 0x0F) << 4
+    packed = (low | high).view(np.int8)               # (K//2, N)
+    return packed, scales
+
+
+def unpack_int4(packed):
+    """(K//2, N) packed int8 → (K, N) int8 in [-7, 7] (sign-extended
+    nibbles; jnp ops only, shared by the kernel and the XLA path)."""
+    p = packed.astype(jnp.int8)
+    low = jnp.right_shift(jnp.left_shift(p, 4), 4)    # sign-extend low
+    high = jnp.right_shift(p, 4)                      # arithmetic
+    kh, n = p.shape
+    return jnp.stack([low, high], axis=1).reshape(2 * kh, n)
+
+
+def _dequant_int4(packed, scales, group):
+    w = unpack_int4(packed).astype(jnp.float32)
+    return w * jnp.repeat(scales, group, axis=0)
+
+
+def _q4mm_kernel(group, x_ref, wq_ref, scale_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    w = _dequant_int4(wq_ref[:], scale_ref[:], group)
+    o_ref[:] = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+def quantized_matmul_int4_pallas(x, packed, scales, *, group=INT4_GROUP,
+                                 block_m=128, block_n=128,
+                                 interpret=False):
+    """x (M, K) @ dequant(packed (K//2, N)) with (K//group, N) scales."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = x.shape
+    kh, n = packed.shape
+    assert k == 2 * kh, (x.shape, packed.shape)
+    bm = min(block_m, m)
+    bn = min(block_n, n)
+    if m % bm or n % bn:
+        raise ValueError(f"shape ({m},{n}) not divisible by ({bm},{bn})")
+    grid = (m // bm, n // bn)
+    return pl.pallas_call(
+        functools.partial(_q4mm_kernel, group),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((kh, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((k // group, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        interpret=interpret,
+    )(x, packed, scales)
+
+
+def quantized_matmul_int4(x, packed, scales, *, group=INT4_GROUP,
+                          interpret=None):
+    """Dispatch like :func:`quantized_matmul`: pallas on TPU (or
+    interpret for tests), XLA dequant-matmul elsewhere."""
+    from sparkdl_tpu.ops._dispatch import block_for, pad_to, use_pallas
+
+    if interpret is None:
+        if not use_pallas():
+            w = _dequant_int4(packed, scales, group)
+            return (x.astype(jnp.float32) @ w).astype(x.dtype)
+        interpret = False
+    m, n = x.shape[0], packed.shape[1]
+    bm, bn = block_for(m), block_for(n, floor=128)
+    x, pad_m = pad_to(x, bm, 0)
+    packed, pad_n = pad_to(packed, bn, 1)
+    scales, _ = pad_to(scales, bn, 1)
+    out = quantized_matmul_int4_pallas(
+        x, packed, scales, group=group, block_m=bm, block_n=bn,
+        interpret=interpret,
+    )
+    return out[:m, :n] if (pad_m or pad_n) else out

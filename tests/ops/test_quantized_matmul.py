@@ -106,3 +106,67 @@ def test_dequantize_roundtrip_applies():
     b = np.asarray(out_f).ravel()
     corr = np.corrcoef(a, b)[0, 1]
     assert corr > 0.999, corr
+
+
+class TestInt4:
+    """Nibble-packed group-wise int4: pack/unpack roundtrip is exact,
+    the kernel equals the dequant-matmul oracle, and accuracy stays
+    bounded by the group scales."""
+
+    def test_pack_unpack_roundtrip_exact(self):
+        import jax
+
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            quantize_int4,
+            unpack_int4,
+        )
+
+        rng = np.random.RandomState(3)
+        w = rng.randn(128, 32).astype(np.float32)
+        packed, scales = quantize_int4(w, group=64)
+        assert packed.shape == (64, 32) and packed.dtype == np.int8
+        assert scales.shape == (2, 32)
+        ints = np.asarray(unpack_int4(jnp.asarray(packed)))
+        assert ints.min() >= -7 and ints.max() <= 7
+        # unpacked ints must be exactly the pre-pack quantized values
+        expect = np.clip(np.round(
+            w.reshape(2, 64, 32) / scales[:, None, :]), -7, 7
+        ).reshape(128, 32)
+        np.testing.assert_array_equal(ints, expect)
+
+    @pytest.mark.parametrize("m", [128, 200])
+    def test_kernel_matches_dequant_matmul(self, m):
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            quantize_int4,
+            quantized_matmul_int4,
+            unpack_int4,
+        )
+
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(m, 128), jnp.float32)
+        w = rng.randn(128, 128).astype(np.float32)
+        packed, s = quantize_int4(w, group=64)
+        out = quantized_matmul_int4(
+            x, jnp.asarray(packed), jnp.asarray(s), group=64,
+            interpret=True,
+        )
+        deq = (np.asarray(unpack_int4(jnp.asarray(packed)), np.float32)
+               * np.repeat(s, 64, axis=0))
+        ref = np.asarray(x) @ deq
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3,
+                                   rtol=1e-4)
+
+    def test_group_scales_bound_error(self):
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            quantize_int4,
+            unpack_int4,
+        )
+
+        rng = np.random.RandomState(5)
+        w = rng.randn(256, 64).astype(np.float32)
+        packed, s = quantize_int4(w, group=64)
+        deq = (np.asarray(unpack_int4(jnp.asarray(packed)), np.float32)
+               * np.repeat(s, 64, axis=0))
+        # per-group symmetric int4: error <= group scale / 2
+        err_bound = np.repeat(s, 64, axis=0) / 2 + 1e-7
+        assert (np.abs(deq - w) <= err_bound).all()
