@@ -761,3 +761,26 @@ def test_speculative_engine_rejects_prefix_registration(setup):
     with pytest.raises(ValueError, match="no prefix caching"):
         eng.register_prefix(np.arange(1, 9, dtype=np.int32))
     assert len(eng._free_pages) == free_before  # no pages leased
+
+
+def test_speculative_engine_int4_draft(setup):
+    """The cheapest draft: int4 weights of the same model (quarter the
+    decode bytes). Greedy outputs must STILL equal the oracle exactly
+    — draft quality moves only the acceptance rate."""
+    import dataclasses as dc
+
+    from sparkdl_tpu.models.quant import quantize_llama_params
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    q4 = quantize_llama_params(params, bits=4)
+    draft = Llama(dc.replace(cfg, quant="int4"))
+    rng = np.random.default_rng(37)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eng = SpeculativeBatchingEngine(
+        model, params, q4, n_slots=2, k=4, draft_model=draft)
+    rid = eng.submit(p, 12)
+    out = eng.run()
+    np.testing.assert_array_equal(
+        out[rid], _oracle(model, params, p, 12))
+    assert 0.0 <= eng.stats["acceptance_rate"] <= 1.0
