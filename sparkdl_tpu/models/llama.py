@@ -12,6 +12,7 @@ north-star config in BASELINE.json), written TPU-first:
 """
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
@@ -52,10 +53,11 @@ class LlamaConfig:
     # block table; the XLA fallback gathers the whole logical view and
     # repeats K/V for GQA — ~3x the HBM traffic on a bandwidth-bound
     # step), "off" = always the gather path, "force_interpret" = run
-    # the kernel interpreted off-TPU (tests). SINGLE-DEVICE ONLY: a
-    # raw pallas_call cannot be partitioned by GSPMD, so under a TP
-    # mesh (head-sharded pool) use "off" — the serving engine does
-    # this automatically when built with mesh=.
+    # the kernel interpreted off-TPU (tests). Under a TP mesh the
+    # serving engine binds the kernel via shard_map over the kv-head
+    # axis (paged_attention_decode_sharded) when the cache is
+    # head-sharded, falling back to the gather path otherwise — a raw
+    # pallas_call cannot be partitioned by GSPMD.
     paged_kernel: str = "auto"
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -203,6 +205,10 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     cfg: LlamaConfig
     attention_fn: Optional[Callable] = None
+    # mesh-bound paged decode kernel (TP serving): the engine injects
+    # ops.pallas.paged_attention.paged_attention_decode_sharded here —
+    # takes priority over cfg.paged_kernel's single-device dispatch
+    paged_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, block_tables=None,
@@ -257,23 +263,34 @@ class Attention(nn.Module):
                 tables, pos_dec // P, axis=1)              # (b, s)
             ck.value = ck.value.at[page_of, pos_dec % P].set(k)
             cv.value = cv.value.at[page_of, pos_dec % P].set(v)
-            if s == 1 and cfg.paged_kernel != "off":
-                from sparkdl_tpu.ops._dispatch import use_pallas
-                from sparkdl_tpu.ops.pallas.paged_attention import (
-                    paged_attention_decode,
-                )
-
-                if (cfg.paged_kernel == "force_interpret"
-                        or use_pallas()):
-                    o = paged_attention_decode(
-                        q[:, 0], ck.value, cv.value, tables,
-                        pos_dec[:, 0] + 1,
-                        interpret=(cfg.paged_kernel
-                                   == "force_interpret"),
+            # Kernel dispatch: the injected (mesh-bound) fn wins, then
+            # the single-device kernel per cfg.paged_kernel — ONE call
+            # + epilogue so the contract (lens = pos+1, o_proj tail)
+            # cannot drift between the two.
+            kernel_fn = None
+            if s == 1:
+                if self.paged_attention_fn is not None:
+                    kernel_fn = self.paged_attention_fn
+                elif cfg.paged_kernel != "off":
+                    from sparkdl_tpu.ops._dispatch import use_pallas
+                    from sparkdl_tpu.ops.pallas.paged_attention import (
+                        paged_attention_decode,
                     )
-                    o = o.reshape(b, s, cfg.n_heads * head_dim)
-                    return _apply_dense(cfg, cfg.d_model, "o_proj", o,
-                                        adapter_ids)
+
+                    if (cfg.paged_kernel == "force_interpret"
+                            or use_pallas()):
+                        kernel_fn = functools.partial(
+                            paged_attention_decode,
+                            interpret=(cfg.paged_kernel
+                                       == "force_interpret"),
+                        )
+            if kernel_fn is not None:
+                o = kernel_fn(
+                    q[:, 0], ck.value, cv.value, tables,
+                    pos_dec[:, 0] + 1,
+                ).reshape(b, s, cfg.n_heads * head_dim)
+                return _apply_dense(cfg, cfg.d_model, "o_proj", o,
+                                    adapter_ids)
             # read: gather each row's pages into its logical view
             L = tables.shape[1] * P
             k = ck.value[tables].reshape(b, L, cfg.n_kv_heads, head_dim)
@@ -423,12 +440,14 @@ class Block(nn.Module):
     cfg: LlamaConfig
     attention_fn: Optional[Callable] = None
     use_moe: bool = False
+    paged_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, block_tables=None,
                  adapter_ids=None):
         cfg = self.cfg
-        h = x + Attention(cfg, self.attention_fn, name="attn")(
+        h = x + Attention(cfg, self.attention_fn,
+                          self.paged_attention_fn, name="attn")(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), cos, sin, positions,
             block_tables=block_tables, adapter_ids=adapter_ids,
         )
@@ -449,6 +468,7 @@ class Block(nn.Module):
 class Llama(nn.Module):
     cfg: LlamaConfig
     attention_fn: Optional[Callable] = None
+    paged_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden=False,
@@ -481,6 +501,7 @@ class Llama(nn.Module):
             use_moe = (cfg.n_experts > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
             x = block(cfg, self.attention_fn, use_moe,
+                      self.paged_attention_fn,
                       name=f"layer_{i}")(x, cos, sin, positions,
                                          block_tables, adapter_ids)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)

@@ -45,17 +45,32 @@ def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
 
 
 @functools.lru_cache(maxsize=64)
-def _engine_programs(dec_cfg, temperature):
+def _engine_programs(dec_cfg, temperature, sharded_mesh=None):
     """(prefill, suffix_prefill, paged_prefill, insert, decode_chunk,
     copy_pages)
     — positional order is load-bearing (the engine's _programs[i]
     properties index it) — jitted once per (decode config,
-    temperature) — module-level like generate._decode_programs, so a
-    fresh engine instance reuses compiled programs instead of paying
-    XLA again (an engine per request burst is the normal usage)."""
+    temperature, sharded mesh) — module-level like
+    generate._decode_programs, so a fresh engine instance reuses
+    compiled programs instead of paying XLA again (an engine per
+    request burst is the normal usage).
+
+    ``sharded_mesh``: a TP mesh to bind the paged decode kernel to
+    (shard_map over the kv-head axis) — set by the engine only when
+    the cache is actually head-sharded and the kernel mode is on."""
     from sparkdl_tpu.models.llama import Llama
 
-    model = Llama(dec_cfg)
+    paged_fn = None
+    if sharded_mesh is not None:
+        from sparkdl_tpu.ops.pallas.paged_attention import (
+            paged_attention_decode_sharded,
+        )
+
+        paged_fn = paged_attention_decode_sharded(
+            sharded_mesh, axis_name="model",
+            interpret=(dec_cfg.paged_kernel == "force_interpret"),
+        )
+    model = Llama(dec_cfg, paged_attention_fn=paged_fn)
 
     def _sample(logits, rng):
         if temperature == 0.0:
@@ -231,18 +246,38 @@ class ContinuousBatchingEngine:
         self._on_token = None  # streaming callback, set per run()
         self._max_pages = (
             -(-cfg.max_cache_len // self.page_size) if page_size else 0)
+        self._paged_sharded_mesh = None  # set only by the TP+kernel path
         if page_size:
             n_pages = (int(n_pages) if n_pages is not None
                        else int(n_slots) * self._max_pages + 1)
             cfg = dataclasses.replace(
                 cfg, page_size=self.page_size, n_pages=n_pages)
-            if mesh is not None and cfg.paged_kernel == "auto":
-                # A raw pallas_call cannot be partitioned by GSPMD:
-                # under TP serving the head-sharded pool would be
-                # all-gathered around the kernel. Gather-path decode
-                # shards fine; the kernel stays single-device until it
-                # grows a shard_map wrapper over the kv-head axis.
-                cfg = dataclasses.replace(cfg, paged_kernel="off")
+            if mesh is not None and cfg.paged_kernel != "off":
+                # A raw pallas_call cannot be partitioned by GSPMD, so
+                # under TP the kernel runs through its shard_map
+                # binding over the kv-head axis (one kernel per shard,
+                # no collectives — GQA query groups are co-resident
+                # with their kv heads). Engage only when the cache is
+                # actually head-sharded (divisibility) and the kernel
+                # would run at all; otherwise the gather path, which
+                # GSPMD shards fine.
+                from sparkdl_tpu.ops._dispatch import use_pallas
+
+                model_size = dict(mesh.shape).get("model", 0)
+                engaged = (
+                    model_size > 0
+                    and cfg.n_kv_heads % model_size == 0
+                    and (cfg.paged_kernel == "force_interpret"
+                         or use_pallas())
+                )
+                if engaged:
+                    self._paged_sharded_mesh = mesh
+                elif cfg.paged_kernel == "auto":
+                    cfg = dataclasses.replace(cfg, paged_kernel="off")
+                # an explicit force_interpret stays: with kv heads not
+                # divisible the cache_spec REPLICATES the pool, where
+                # the raw (unsharded) kernel call is valid — never
+                # silently downgrade a user's explicit kernel mode
         self.cfg = dataclasses.replace(cfg, decode=True)
         self.n_slots = int(n_slots)
         self.temperature = float(temperature)
@@ -328,7 +363,8 @@ class ContinuousBatchingEngine:
 
     @property
     def _programs(self):
-        return _engine_programs(self.cfg, self.temperature)
+        return _engine_programs(self.cfg, self.temperature,
+                                self._paged_sharded_mesh)
 
     @property
     def _prefill_fn(self):

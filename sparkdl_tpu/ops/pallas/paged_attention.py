@@ -142,3 +142,34 @@ def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
         interpret=interpret,
     )(tables, lens, qg, k_pool, v_pool)
     return out.reshape(b, h, d)
+
+
+def paged_attention_decode_sharded(mesh, *, axis_name="model",
+                                   scale=None, interpret=False):
+    """Bind the paged decode kernel to a TP mesh: the pool is sharded
+    over its kv-head axis on ``axis_name`` (exactly the serving
+    engine's cache sharding) and each device runs the kernel on its
+    LOCAL kv heads — every kv head's GQA query group is co-resident
+    with it, so the shard_map needs no collectives at all; the o_proj
+    that follows does the psum, same as the gather path.
+
+    Returns ``f(q, k_pool, v_pool, tables, lens)`` on GLOBAL arrays:
+    q (B, H, D) sharded over heads, pools (P, page, Hkv, D) sharded
+    over kv heads, tables/lens replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def local_fn(q, k_pool, v_pool, tables, lens):
+        return paged_attention_decode(
+            q, k_pool, v_pool, tables, lens, scale=scale,
+            interpret=interpret,
+        )
+
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(None, axis_name, None),
+                  P(None, None, axis_name, None),
+                  P(None, None, axis_name, None),
+                  P(), P()),
+        out_specs=P(None, axis_name, None),
+        check_vma=False,
+    )
