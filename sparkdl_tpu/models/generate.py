@@ -18,8 +18,38 @@ import jax
 import jax.numpy as jnp
 
 
+NEG_INF = -1e30
+
+
+def sample_logits(logits, rng, *, temperature, top_k=0, top_p=1.0):
+    """One sampling step over (..., V) logits: greedy at temperature 0,
+    else temperature-scaled categorical restricted by ``top_k`` (keep
+    the k largest) and/or ``top_p`` (nucleus: keep the smallest prefix
+    of the sorted distribution whose mass reaches p — the top token
+    always survives). Pure and jit-safe; the single sampling
+    definition for generate() and both serving engines."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jax.lax.top_k(l, top_k)[0][..., -1:]
+        l = jnp.where(l < kth, NEG_INF, l)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        # keep entries whose cumulative mass BEFORE them is < p: the
+        # first token always survives, the nucleus is the minimal
+        # prefix reaching p
+        before = jnp.cumsum(probs, axis=-1) - probs
+        keep = before < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_l, jnp.inf), axis=-1, keepdims=True)
+        l = jnp.where(l < cutoff, NEG_INF, l)
+    return jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=64)
-def _decode_programs(dec_cfg, temperature):
+def _decode_programs(dec_cfg, temperature, top_k=0, top_p=1.0):
     """(prefill, decode_loop) jitted for one decode config. Cached so a
     second generate() call with the same config compiles nothing."""
     from sparkdl_tpu.models.llama import Llama
@@ -27,11 +57,8 @@ def _decode_programs(dec_cfg, temperature):
     dec_model = Llama(dec_cfg)
 
     def _next_token(logits, rng):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        return sample_logits(logits, rng, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     @jax.jit
     def prefill(params, tokens, rng):
@@ -63,12 +90,16 @@ def _decode_programs(dec_cfg, temperature):
 
 
 def generate(model, params, prompt_tokens, *, max_new_tokens=32,
-             temperature=0.0, rng=None, eos_id=None):
+             temperature=0.0, top_k=0, top_p=1.0, rng=None,
+             eos_id=None):
     """Generate continuations.
 
     :param model: a Llama (training or decode config — a decode-mode
         twin is derived automatically; params are shared).
     :param prompt_tokens: (batch, prompt_len) int32.
+    :param top_k: sample only among the k most likely tokens (0 = all).
+    :param top_p: nucleus sampling — the minimal top mass kept
+        (1.0 = all). Both restrictions need ``temperature > 0``.
     :return: (batch, prompt_len + n) tokens, n <= max_new_tokens
         (shorter when every row has emitted ``eos_id``).
     """
@@ -82,7 +113,8 @@ def generate(model, params, prompt_tokens, *, max_new_tokens=32,
             "LlamaConfig.max_cache_len"
         )
     dec_cfg = dataclasses.replace(cfg, decode=True)
-    prefill, decode_loop = _decode_programs(dec_cfg, float(temperature))
+    prefill, decode_loop = _decode_programs(
+        dec_cfg, float(temperature), int(top_k), float(top_p))
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

@@ -45,7 +45,8 @@ def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
 
 
 @functools.lru_cache(maxsize=64)
-def _engine_programs(dec_cfg, temperature, sharded_mesh=None):
+def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
+                     top_p=1.0):
     """(prefill, suffix_prefill, paged_prefill, insert, decode_chunk,
     copy_pages)
     — positional order is load-bearing (the engine's _programs[i]
@@ -73,11 +74,10 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None):
     model = Llama(dec_cfg, paged_attention_fn=paged_fn)
 
     def _sample(logits, rng):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        from sparkdl_tpu.models.generate import sample_logits
+
+        return sample_logits(logits, rng, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     @jax.jit
     def prefill(params, padded_prompt, rng, true_len, adapter_ids=None):
@@ -208,7 +208,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, params, *, n_slots=4, temperature=0.0,
                  eos_id=None, chunk=16, rng=None, mesh=None,
                  rules=None, page_size=0, n_pages=None,
-                 prefill_chunk=0):
+                 prefill_chunk=0, top_k=0, top_p=1.0):
         """``mesh`` enables tensor-parallel serving: params are placed
         per ``rules`` (default TRANSFORMER_RULES — Megatron column/row
         splits) and the KV cache is sharded over its kv-heads axis on
@@ -281,6 +281,10 @@ class ContinuousBatchingEngine:
         self.cfg = dataclasses.replace(cfg, decode=True)
         self.n_slots = int(n_slots)
         self.temperature = float(temperature)
+        # sampling restrictions (temperature > 0): top_k keeps the k
+        # most likely tokens, top_p the minimal nucleus reaching p
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
         self.eos_id = eos_id
         self.chunk = int(chunk)
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -364,7 +368,8 @@ class ContinuousBatchingEngine:
     @property
     def _programs(self):
         return _engine_programs(self.cfg, self.temperature,
-                                self._paged_sharded_mesh)
+                                self._paged_sharded_mesh,
+                                self.top_k, self.top_p)
 
     @property
     def _prefill_fn(self):

@@ -123,3 +123,68 @@ def test_eos_truncates_when_all_rows_finish():
     out = generate(model, params, prompt, max_new_tokens=6,
                    eos_id=int(gen[0, j]))
     assert out.shape[1] <= prompt.shape[1] + j + 1
+
+
+class TestSampleLogits:
+    """top-k / top-p restriction math on the shared sampling helper."""
+
+    def _logits(self):
+        # probs ~ [0.5, 0.3, 0.15, 0.05] at temperature 1
+        p = np.array([0.5, 0.3, 0.15, 0.05], np.float32)
+        return jnp.log(jnp.asarray(p))[None, :]
+
+    def test_top_k_one_is_argmax(self):
+        from sparkdl_tpu.models.generate import sample_logits
+
+        l = self._logits()
+        for seed in range(5):
+            tok = sample_logits(l, jax.random.PRNGKey(seed),
+                                temperature=0.7, top_k=1)
+            assert int(tok[0]) == 0
+
+    def test_top_k_restricts_support(self):
+        from sparkdl_tpu.models.generate import sample_logits
+
+        l = jnp.repeat(self._logits(), 2000, axis=0)
+        toks = np.asarray(sample_logits(
+            l, jax.random.PRNGKey(0), temperature=1.0, top_k=2))
+        assert set(np.unique(toks)) == {0, 1}
+        # renormalized frequencies ~ [0.625, 0.375]
+        f0 = (toks == 0).mean()
+        assert abs(f0 - 0.625) < 0.04, f0
+
+    def test_top_p_nucleus(self):
+        from sparkdl_tpu.models.generate import sample_logits
+
+        l = jnp.repeat(self._logits(), 2000, axis=0)
+        # nucleus 0.7: mass-before is [0, .5, .8, .95] -> keep {0, 1}
+        toks = np.asarray(sample_logits(
+            l, jax.random.PRNGKey(1), temperature=1.0, top_p=0.7))
+        assert set(np.unique(toks)) == {0, 1}
+        # tiny p: top token always survives
+        toks = np.asarray(sample_logits(
+            l, jax.random.PRNGKey(2), temperature=1.0, top_p=1e-6))
+        assert set(np.unique(toks)) == {0}
+
+    def test_unrestricted_matches_plain_categorical(self):
+        from sparkdl_tpu.models.generate import sample_logits
+
+        l = jnp.repeat(self._logits(), 4000, axis=0)
+        key = jax.random.PRNGKey(3)
+        toks = np.asarray(sample_logits(l, key, temperature=1.0))
+        ref = np.asarray(jax.random.categorical(key, l, axis=-1))
+        np.testing.assert_array_equal(toks, ref)
+
+
+def test_generate_top_k_one_equals_greedy():
+    """top_k=1 at any temperature is greedy — end to end through the
+    cached decode loop."""
+    cfg, model, params, _ = _setup()
+    rng = np.random.default_rng(43)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 6)), jnp.int32)
+    greedy = generate(model, params, prompt, max_new_tokens=8,
+                      temperature=0.0)
+    topk1 = generate(model, params, prompt, max_new_tokens=8,
+                     temperature=0.9, top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))

@@ -822,3 +822,26 @@ def test_tp_paged_kernel_matches_single_device(setup):
     got = run(tp_k)
     for b, t in zip(base, got):
         np.testing.assert_array_equal(b, t)
+
+
+def test_engine_top_k_one_equals_greedy_engine(setup):
+    """Engine-level top_k=1 at temperature>0 must produce exactly the
+    greedy engine's tokens — the restriction flows through the shared
+    sample_logits into every program (prefill + decode chunks)."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(47)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9)]
+
+    def run(engine):
+        rids = [engine.submit(p, 8) for p in prompts]
+        res = engine.run()
+        return [res[r] for r in rids]
+
+    greedy = run(ContinuousBatchingEngine(model, params, n_slots=2,
+                                          chunk=4))
+    topk1 = run(ContinuousBatchingEngine(model, params, n_slots=2,
+                                         chunk=4, temperature=0.9,
+                                         top_k=1))
+    for g, t in zip(greedy, topk1):
+        np.testing.assert_array_equal(g, t)
