@@ -21,16 +21,14 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def sample_logits(logits, rng, *, temperature, top_k=0, top_p=1.0):
-    """One sampling step over (..., V) logits: greedy at temperature 0,
-    else temperature-scaled categorical restricted by ``top_k`` (keep
-    the k largest) and/or ``top_p`` (nucleus: keep the smallest prefix
-    of the sorted distribution whose mass reaches p — the top token
-    always survives). Pure and jit-safe; the single sampling
-    definition for generate() and both serving engines."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    l = logits.astype(jnp.float32) / temperature
+def restrict_logits(logits, *, top_k=0, top_p=1.0):
+    """Mask (..., V) TEMPERATURE-SCALED logits down to the sampling
+    support: ``top_k`` keeps the k largest, ``top_p`` keeps the
+    minimal sorted prefix whose mass reaches p (the top token always
+    survives). Pure; shared by direct sampling and the speculative
+    rejection scheme (which needs the restricted DISTRIBUTIONS, not
+    just samples)."""
+    l = logits.astype(jnp.float32)
     if top_k:
         kth = jax.lax.top_k(l, top_k)[0][..., -1:]
         l = jnp.where(l < kth, NEG_INF, l)
@@ -45,6 +43,18 @@ def sample_logits(logits, rng, *, temperature, top_k=0, top_p=1.0):
         cutoff = jnp.min(
             jnp.where(keep, sorted_l, jnp.inf), axis=-1, keepdims=True)
         l = jnp.where(l < cutoff, NEG_INF, l)
+    return l
+
+
+def sample_logits(logits, rng, *, temperature, top_k=0, top_p=1.0):
+    """One sampling step over (..., V) logits: greedy at temperature 0,
+    else temperature-scaled categorical restricted by
+    :func:`restrict_logits`. The single sampling definition for
+    generate() and both serving engines."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = restrict_logits(logits.astype(jnp.float32) / temperature,
+                        top_k=top_k, top_p=top_p)
     return jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
 
 

@@ -857,18 +857,31 @@ class ContinuousBatchingEngine:
 
 
 @functools.lru_cache(maxsize=8)
-def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature):
+def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature, top_k=0,
+                          top_p=1.0):
     """(draft_prefill, draft_insert, spec_round) — jitted once per
     (target config, draft config, k, temperature). temperature == 0:
     greedy longest-agreeing-prefix acceptance (token-exact vs plain
     greedy decode). temperature > 0: distribution-exact rejection
     sampling (models/speculative.spec_sample_tokens) — marginals equal
     target-only sampling, the draft moves only throughput."""
+    from sparkdl_tpu.models.generate import restrict_logits
     from sparkdl_tpu.models.llama import Llama
     from sparkdl_tpu.models.speculative import spec_sample_tokens
 
     target = Llama(dec_cfg)
     draft = Llama(draft_cfg)
+
+    def _restricted_probs(logits):
+        # the rejection scheme is exact for whatever target
+        # distribution it is fed: restricting BOTH p and q to the
+        # top-k/nucleus support makes the output distribution equal
+        # restricted-target-only sampling (vLLM's composition)
+        return jax.nn.softmax(
+            restrict_logits(logits / temperature, top_k=top_k,
+                            top_p=top_p),
+            axis=-1,
+        )
 
     @jax.jit
     def draft_prefill(d_params, padded_prompt):
@@ -913,9 +926,10 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature):
                 nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 q_row = jnp.zeros_like(last)  # unused in greedy
             else:
-                q_row = jax.nn.softmax(last / temperature, axis=-1)
+                q_row = _restricted_probs(last)
                 nxt = jax.random.categorical(
-                    step_rng, last / temperature, axis=-1
+                    step_rng, jnp.log(jnp.maximum(q_row, 1e-30)),
+                    axis=-1,
                 ).astype(jnp.int32)
             p = jnp.where(active, jnp.minimum(p + 1, L - 1), p)
             return (st["cache"], nxt, p), (nxt, q_row)
@@ -955,7 +969,7 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature):
             tokens, counts = assemble_round(prop, m, final)
         else:
             rng, s_rng = jax.random.split(rng)
-            p_probs = jax.nn.softmax(logits / temperature, axis=-1)
+            p_probs = _restricted_probs(logits)
             tokens, counts = spec_sample_tokens(
                 q_probs.transpose(1, 0, 2), p_probs, prop, s_rng)
         return st["cache"], d_cache, tokens, counts, rng
@@ -990,7 +1004,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, model, params, draft_params, *, n_slots=4,
                  eos_id=None, k=4, rng=None, draft_model=None,
-                 temperature=0.0, page_size=0, n_pages=None):
+                 temperature=0.0, page_size=0, n_pages=None,
+                 top_k=0, top_p=1.0):
         cfg = model.cfg
         if cfg.multi_lora:
             raise ValueError(
@@ -1002,7 +1017,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             raise ValueError(f"k must be >= 1, got {k}")
         super().__init__(model, params, n_slots=n_slots,
                          temperature=temperature, eos_id=eos_id,
-                         rng=rng, page_size=page_size, n_pages=n_pages)
+                         rng=rng, page_size=page_size, n_pages=n_pages,
+                         top_k=top_k, top_p=top_p)
         d_base = draft_model.cfg if draft_model is not None else cfg
         self._draft_cfg = dataclasses.replace(
             d_base, decode=True, max_cache_len=self.cfg.max_cache_len,
@@ -1021,7 +1037,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     @property
     def _spec_programs(self):
         return _spec_engine_programs(self.cfg, self._draft_cfg, self.k,
-                                     self.temperature)
+                                     self.temperature, self.top_k,
+                                     self.top_p)
 
     def _worst_case_tokens(self, p_len, max_new):
         # + k scratch: a verify may write k positions past the final

@@ -845,3 +845,23 @@ def test_engine_top_k_one_equals_greedy_engine(setup):
                                          top_k=1))
     for g, t in zip(greedy, topk1):
         np.testing.assert_array_equal(g, t)
+
+
+def test_speculative_sampling_top_k_one_equals_oracle(setup):
+    """top_k=1 makes restricted speculative SAMPLING deterministic:
+    both p and q collapse to their argmax, acceptance compares
+    argmaxes, and the output must equal the greedy oracle exactly —
+    the strongest end-to-end check of the restricted rejection
+    scheme."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(53)
+    p = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    eng = SpeculativeBatchingEngine(
+        model, params, params, n_slots=2, k=3, temperature=0.8,
+        top_k=1)
+    rid = eng.submit(p, 10)
+    out = eng.run()
+    np.testing.assert_array_equal(
+        out[rid], _oracle(model, params, p, 10))
