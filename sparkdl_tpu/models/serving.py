@@ -44,6 +44,17 @@ def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
     raise ValueError(f"prompt length {n} exceeds the largest bucket")
 
 
+def _pad_bucket(tokens, cap):
+    """Bucket-pad a 1-D token array to ``min(_bucket(len), cap)`` as a
+    (1, bucket) int32 batch — ONE definition of the prefill padding
+    policy (target + draft, full prompts + suffixes)."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    bucket = min(_bucket(len(tokens)), cap)
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :len(tokens)] = tokens
+    return padded
+
+
 @functools.lru_cache(maxsize=64)
 def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
                      top_p=1.0):
@@ -449,9 +460,7 @@ class ContinuousBatchingEngine:
             pages = [self._free_pages.pop() for _ in range(need)]
             table = np.zeros((1, self._max_pages), np.int32)
             table[0, :need] = pages
-            bucket = min(_bucket(p_len), self.cfg.max_cache_len)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p_len] = prefix
+            padded = _pad_bucket(prefix, self.cfg.max_cache_len)
             self._cache, _tok = self._paged_prefill_fn(
                 self.params, self._cache, jnp.asarray(padded),
                 jnp.asarray(table), sub,
@@ -461,9 +470,7 @@ class ContinuousBatchingEngine:
             pid = f"prefix-{len(self._prefixes)}"
             self._prefixes[pid] = (prefix, pages, adapter_id)
             return pid
-        bucket = min(_bucket(p_len), self.cfg.max_cache_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p_len] = prefix
+        padded = _pad_bucket(prefix, self.cfg.max_cache_len)
         cache, _ = self._prefill_fn(
             self.params, jnp.asarray(padded), sub, p_len,
             adapter_ids=self._adapter_arg(adapter_id),
@@ -683,10 +690,8 @@ class ContinuousBatchingEngine:
         if prefix_id is not None:
             prefix, prefix_cache, _pfx_adapter = self._prefixes[prefix_id]
             suffix = prompt[len(prefix):]
-            bucket = min(_bucket(len(suffix)),
-                         self.cfg.max_cache_len - len(prefix))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :len(suffix)] = suffix
+            padded = _pad_bucket(
+                suffix, self.cfg.max_cache_len - len(prefix))
             one_cache, tok = self._suffix_prefill_fn(
                 self.params, prefix_cache, jnp.asarray(padded), sub,
                 len(suffix),
@@ -695,9 +700,7 @@ class ContinuousBatchingEngine:
             self.stats["prefill_tokens_saved"] = (
                 self.stats.get("prefill_tokens_saved", 0) + len(prefix))
         else:
-            bucket = min(_bucket(p_len), self.cfg.max_cache_len)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p_len] = prompt
+            padded = _pad_bucket(prompt, self.cfg.max_cache_len)
             one_cache, tok = self._prefill_fn(
                 self.params, jnp.asarray(padded), sub, p_len,
                 adapter_ids=self._adapter_arg(adapter_id),
@@ -859,8 +862,9 @@ class ContinuousBatchingEngine:
 @functools.lru_cache(maxsize=8)
 def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature, top_k=0,
                           top_p=1.0):
-    """(draft_prefill, draft_insert, spec_round) — jitted once per
-    (target config, draft config, k, temperature). temperature == 0:
+    """(draft_prefill, draft_insert, draft_suffix_prefill,
+    spec_round) — jitted once per (target config, draft config, k,
+    temperature, top_k, top_p). temperature == 0:
     greedy longest-agreeing-prefix acceptance (token-exact vs plain
     greedy decode). temperature > 0: distribution-exact rejection
     sampling (models/speculative.spec_sample_tokens) — marginals equal
@@ -900,6 +904,17 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature, top_k=0,
             ),
             d_cache, one_cache,
         )
+
+    @jax.jit
+    def draft_suffix_prefill(d_params, prefix_cache, padded_suffix):
+        """Continue a stored DRAFT prefix cache over a request's
+        suffix (logits discarded) — the draft-side twin of the
+        engine's suffix_prefill."""
+        _, st = draft.apply(
+            {"params": d_params, "cache": prefix_cache}, padded_suffix,
+            mutable=["cache"],
+        )
+        return st["cache"]
 
     @functools.partial(jax.jit, donate_argnums=(1, 3))
     def spec_round(params, cache, d_params, d_cache, token, pos,
@@ -974,7 +989,7 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature, top_k=0,
                 q_probs.transpose(1, 0, 2), p_probs, prop, s_rng)
         return st["cache"], d_cache, tokens, counts, rng
 
-    return draft_prefill, draft_insert, spec_round
+    return draft_prefill, draft_insert, draft_suffix_prefill, spec_round
 
 
 class SpeculativeBatchingEngine(ContinuousBatchingEngine):
@@ -998,8 +1013,12 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     dense slot cache — proposals are the draft's problem, and a dense
     (typically int8) draft cache is simpler than a second page pool.
 
-    Out of scope (raises): multi-adapter, prefix caching, chunked
-    prefill, TP mesh.
+    Prefix caching works on both sides: the target through the base
+    engine's dense-copy / shared-pool-pages machinery, the draft
+    through its own dense prefix caches — prefixed admissions prefill
+    only the suffix on both models.
+
+    Out of scope (raises): multi-adapter, chunked prefill, TP mesh.
     """
 
     def __init__(self, model, params, draft_params, *, n_slots=4,
@@ -1025,6 +1044,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             page_size=0, n_pages=0,
         )
         self.draft_params = draft_params
+        self._draft_prefixes = {}  # prefix_id -> draft dense cache
         from sparkdl_tpu.models.llama import Llama
 
         dummy = jnp.zeros((self.n_slots, 1), jnp.int32)
@@ -1048,10 +1068,6 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
                adapter_id=0):
-        if prefix_id is not None:
-            raise ValueError(
-                "SpeculativeBatchingEngine has no prefix caching "
-                "(the draft would need its own prefix cache)")
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if self._worst_case_tokens(len(prompt), max_new_tokens) \
                 > self.cfg.max_cache_len:
@@ -1063,19 +1079,34 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 "lower k"
             )
         return super().submit(prompt, max_new_tokens,
+                              prefix_id=prefix_id,
                               adapter_id=adapter_id)
 
     def register_prefix(self, prefix_tokens, adapter_id=0):
-        raise ValueError(
-            "SpeculativeBatchingEngine has no prefix caching (the "
-            "draft would need its own prefix cache); on a paged "
-            "engine a stray registration would also permanently "
-            "lease pool pages no submit() could ever use"
-        )
+        """Shared-prefix caching for BOTH models: the target side goes
+        through the base engine (dense cache copy or read-only shared
+        pool pages); the draft keeps its own dense prefix cache, so a
+        prefixed admission prefills only the suffix on both sides —
+        and the draft stays position-correct, which speculation's
+        acceptance rate depends on."""
+        pid = super().register_prefix(prefix_tokens, adapter_id)
+        draft_prefill = self._spec_programs[0]
+        prefix = np.asarray(prefix_tokens, np.int32).reshape(-1)
+        padded = _pad_bucket(prefix, self.cfg.max_cache_len)
+        d_cache = draft_prefill(self.draft_params, jnp.asarray(padded))
+        # pin the shared index to the TRUE length (the bucket-padded
+        # prefill advanced it to the bucket) — mirrors the base
+        # engine's dense prefix path
+        d_cache = jax.tree.map(
+            lambda x: jnp.full(x.shape, len(prefix), x.dtype)
+            if x.ndim == 0 else x, d_cache)
+        self._draft_prefixes[pid] = d_cache
+        return pid
 
-    def _draft_admit(self, slot_idx, prompt):
-        """Prompt through the draft into its dense slot cache —
-        shared epilogue of both admission paths."""
+    def _draft_admit(self, slot_idx, prompt, prefix_id):
+        """Prompt (or its suffix past a cached prefix) through the
+        draft into its dense slot cache — shared epilogue of both
+        admission paths."""
         if slot_idx in self._prefilling:
             # chunked prefill STAGES the slot inactive; the early
             # return below would then skip the draft prefill and this
@@ -1091,28 +1122,35 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             # budget): the slot will be re-admitted fresh — don't pay
             # a draft prefill + full-tree insert for it
             return
-        draft_prefill, draft_insert, _ = self._spec_programs
-        bucket = min(_bucket(len(prompt)), self.cfg.max_cache_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(prompt)] = prompt
-        one = draft_prefill(self.draft_params, jnp.asarray(padded))
+        draft_prefill, draft_insert, draft_suffix_prefill = \
+            self._spec_programs[:3]
+        if prefix_id is not None:
+            prefix, _, _aid = self._prefixes[prefix_id]
+            padded = _pad_bucket(prompt[len(prefix):],
+                                 self.cfg.max_cache_len - len(prefix))
+            one = draft_suffix_prefill(
+                self.draft_params, self._draft_prefixes[prefix_id],
+                jnp.asarray(padded))
+        else:
+            padded = _pad_bucket(prompt, self.cfg.max_cache_len)
+            one = draft_prefill(self.draft_params, jnp.asarray(padded))
         self._d_cache = draft_insert(self._d_cache, one, slot_idx)
 
     def _admit(self, slot_idx):
         # capture before super() pops the queue head
-        _, prompt, _, _, _ = self._queue[0]
+        _, prompt, _, prefix_id, _ = self._queue[0]
         super()._admit(slot_idx)
-        self._draft_admit(slot_idx, prompt)
+        self._draft_admit(slot_idx, prompt, prefix_id)
 
     def _try_admit_paged(self, slot_idx):
-        _, prompt, _, _, _ = self._queue[0]
+        _, prompt, _, prefix_id, _ = self._queue[0]
         if not super()._try_admit_paged(slot_idx):
             return False
-        self._draft_admit(slot_idx, prompt)
+        self._draft_admit(slot_idx, prompt, prefix_id)
         return True
 
     def _run(self, progress):
-        _, _, spec_round = self._spec_programs
+        spec_round = self._spec_programs[3]
         while (self._queue or self._prefilling
                or any(s.active for s in self._slots)):
             active = self._fill_slots()

@@ -751,16 +751,34 @@ def test_speculative_paged_scratch_reservation(setup):
         (0, np.zeros(16, np.int32), 16, None, 0)) == 3
 
 
-def test_speculative_engine_rejects_prefix_registration(setup):
+@pytest.mark.parametrize("page_size", [0, 16])
+def test_speculative_prefix_caching_is_exact(setup, page_size):
+    """Prefix caching on the speculative engine: prefixed requests
+    must match the full-prompt oracle exactly, prefill savings are
+    tracked, and — the sharp check — a PERFECT draft keeps acceptance
+    at 1.0, which fails immediately if the draft's prefix cache is
+    position-shifted or stale."""
     from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
 
     cfg, model, params = setup
+    rng = np.random.default_rng(59)
+    system = rng.integers(0, cfg.vocab_size, (11,)).astype(np.int32)
+    suffixes = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                for n in (4, 6)]
+    prompts = [np.concatenate([system, s]) for s in suffixes]
+
     eng = SpeculativeBatchingEngine(
-        model, params, params, n_slots=2, k=2, page_size=16)
-    free_before = len(eng._free_pages)
-    with pytest.raises(ValueError, match="no prefix caching"):
-        eng.register_prefix(np.arange(1, 9, dtype=np.int32))
-    assert len(eng._free_pages) == free_before  # no pages leased
+        model, params, params, n_slots=2, k=3, page_size=page_size)
+    pid = eng.register_prefix(system)
+    rids = [eng.submit(p, 8, prefix_id=pid) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(
+            out[rid], _oracle(model, params, p, 8),
+            err_msg=f"page_size={page_size} prefixed request diverged",
+        )
+    assert eng.stats["prefill_tokens_saved"] == 2 * len(system)
+    assert eng.stats["acceptance_rate"] == 1.0
 
 
 def test_speculative_engine_int4_draft(setup):
