@@ -44,6 +44,12 @@ def _bucket(n, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)):
     raise ValueError(f"prompt length {n} exceeds the largest bucket")
 
 
+def _hits_stop(tokens, stops):
+    """True when any stop sequence is a suffix of ``tokens``."""
+    return any(len(tokens) >= len(st)
+               and tuple(tokens[-len(st):]) == st for st in stops)
+
+
 def _pad_bucket(tokens, cap):
     """Bucket-pad a 1-D token array to ``min(_bucket(len), cap)`` as a
     (1, bucket) int32 batch — ONE definition of the prefill padding
@@ -308,6 +314,9 @@ class ContinuousBatchingEngine:
                              #   cache | pool pages, adapter_id)
         self._slots = [_Slot() for _ in range(self.n_slots)]
         self._results = {}
+        self._stops = {}           # rid -> tuple of stop token tuples
+        self._finish_reasons = {}  # rid -> "eos" | "length" | "stop"
+        self.finish_reasons = {}   # last drained burst's reasons
         self._next_id = 0
         self.stats = {"steps": 0, "active_slot_steps": 0,
                       "total_slot_steps": 0}
@@ -486,12 +495,15 @@ class ContinuousBatchingEngine:
         return pid
 
     def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
-               adapter_id=0):
+               adapter_id=0, stop=None):
         """Queue a request; returns its id. ``prefix_id`` (from
         :meth:`register_prefix`): the prompt must START with that
         prefix and extend it by at least one token. ``adapter_id``
         selects this request's LoRA adapter when the engine serves a
-        multi-adapter tree (cfg.multi_lora)."""
+        multi-adapter tree (cfg.multi_lora). ``stop``: token-id
+        sequences that end THIS request's generation when they appear
+        (the stop sequence is included in the output, like eos);
+        finish causes land in :attr:`finish_reasons` after run()."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if self.cfg.multi_lora:
             if not 0 <= adapter_id < self.cfg.multi_lora:
@@ -535,6 +547,13 @@ class ContinuousBatchingEngine:
                 )
         rid = self._next_id
         self._next_id += 1
+        if stop:
+            seqs = tuple(
+                tuple(int(t) for t in np.asarray(s).reshape(-1))
+                for s in stop)
+            if any(not s for s in seqs):
+                raise ValueError("empty stop sequence")
+            self._stops[rid] = seqs
         self._queue.append(
             (rid, prompt, int(max_new_tokens), prefix_id,
              int(adapter_id)))
@@ -679,9 +698,12 @@ class ContinuousBatchingEngine:
         s.tokens = [int(np.asarray(tok)[0])]
         if self._on_token is not None:
             self._on_token(rid, s.tokens[0])
-        if (self.eos_id is not None and s.tokens[0] == self.eos_id) \
-                or s.remaining == 0:
-            self._finish(slot_idx)
+        if self.eos_id is not None and s.tokens[0] == self.eos_id:
+            self._finish(slot_idx, "eos")
+        elif _hits_stop(s.tokens, self._stops.get(rid, ())):
+            self._finish(slot_idx, "stop")
+        elif s.remaining == 0:
+            self._finish(slot_idx, "length")
 
     def _admit(self, slot_idx):
         rid, prompt, max_new, prefix_id, adapter_id = self._queue.pop(0)
@@ -712,9 +734,11 @@ class ContinuousBatchingEngine:
         self._adapter_ids[slot_idx] = adapter_id
         self._activate_slot(slot_idx, rid, max_new, tok)
 
-    def _finish(self, slot_idx):
+    def _finish(self, slot_idx, reason="length"):
         s = self._slots[slot_idx]
         self._results[s.req_id] = np.asarray(s.tokens, np.int32)
+        self._finish_reasons[s.req_id] = reason
+        self._stops.pop(s.req_id, None)
         s.active = False
         s.tokens = []
         if self.page_size:
@@ -829,23 +853,32 @@ class ContinuousBatchingEngine:
         trailing tokens past eos/budget are discarded. ONE definition
         shared by the chunked and the speculative decode loops."""
         s = self._slots[slot_idx]
+        stops = self._stops.get(s.req_id, ())
         for t in tokens:
             s.tokens.append(int(t))
             s.remaining -= 1
             if self._on_token is not None:
                 self._on_token(s.req_id, int(t))
-            if ((self.eos_id is not None and int(t) == self.eos_id)
-                    or s.remaining == 0):
-                self._finish(slot_idx)
+            if self.eos_id is not None and int(t) == self.eos_id:
+                self._finish(slot_idx, "eos")
+                return True
+            if stops and _hits_stop(s.tokens, stops):
+                self._finish(slot_idx, "stop")
+                return True
+            if s.remaining == 0:
+                self._finish(slot_idx, "length")
                 return True
         return False
 
     def _drain_results(self):
-        """Final stats + hand the burst's results to the caller."""
+        """Final stats + hand the burst's results to the caller;
+        per-request finish causes land in :attr:`finish_reasons`."""
         self.stats["utilization"] = (
             self.stats["active_slot_steps"]
             / max(1, self.stats["total_slot_steps"])
         )
+        self.finish_reasons = self._finish_reasons
+        self._finish_reasons = {}
         out = self._results
         self._results = {}
         return out
@@ -1067,7 +1100,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         return p_len + max_new + self.k
 
     def submit(self, prompt_tokens, max_new_tokens, prefix_id=None,
-               adapter_id=0):
+               adapter_id=0, stop=None):
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if self._worst_case_tokens(len(prompt), max_new_tokens) \
                 > self.cfg.max_cache_len:
@@ -1080,7 +1113,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             )
         return super().submit(prompt, max_new_tokens,
                               prefix_id=prefix_id,
-                              adapter_id=adapter_id)
+                              adapter_id=adapter_id, stop=stop)
 
     def register_prefix(self, prefix_tokens, adapter_id=0):
         """Shared-prefix caching for BOTH models: the target side goes

@@ -883,3 +883,50 @@ def test_speculative_sampling_top_k_one_equals_oracle(setup):
     out = eng.run()
     np.testing.assert_array_equal(
         out[rid], _oracle(model, params, p, 10))
+
+
+def test_stop_sequences_and_finish_reasons(setup):
+    """A submitted stop sequence ends generation when it appears (stop
+    tokens included in the output, like eos), per-request; finish
+    causes are reported per burst. The sequence is taken from the
+    oracle so it actually occurs mid-stream."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(61)
+    p1 = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+    ref1 = _oracle(model, params, p1, 10)
+    stop_seq = [int(ref1[2]), int(ref1[3])]  # hits after 4 tokens
+
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4)
+    r1 = eng.submit(p1, 10, stop=[stop_seq])
+    r2 = eng.submit(p2, 5)           # no stop: runs to budget
+    out = eng.run()
+    np.testing.assert_array_equal(out[r1], ref1[:4])
+    assert eng.finish_reasons[r1] == "stop"
+    assert len(out[r2]) == 5
+    assert eng.finish_reasons[r2] == "length"
+
+    # stop is PER REQUEST: a new burst without it decodes past it
+    r3 = eng.submit(p1, 10)
+    out2 = eng.run()
+    np.testing.assert_array_equal(out2[r3], ref1)
+    assert eng.finish_reasons[r3] == "length"
+
+
+def test_stop_sequences_on_speculative_engine(setup):
+    """Stop handling rides the shared _accept_tokens, so a stop
+    landing MID-round truncates the accepted block too."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(67)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ref = _oracle(model, params, p, 12)
+    stop_seq = [int(ref[4]), int(ref[5])]
+
+    eng = SpeculativeBatchingEngine(model, params, params, n_slots=2,
+                                    k=4)
+    rid = eng.submit(p, 12, stop=[stop_seq])
+    out = eng.run()
+    np.testing.assert_array_equal(out[rid], ref[:6])
+    assert eng.finish_reasons[rid] == "stop"
