@@ -51,11 +51,28 @@ def sample_logits(logits, rng, *, temperature, top_k=0, top_p=1.0):
     else temperature-scaled categorical restricted by
     :func:`restrict_logits`. The single sampling definition for
     generate() and both serving engines."""
+    return sample_logits_with_lp(logits, rng, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)[0]
+
+
+def sample_logits_with_lp(logits, rng, *, temperature, top_k=0,
+                          top_p=1.0):
+    """(token, logprob): one sampling step plus the chosen token's
+    logprob under the DISTRIBUTION ACTUALLY SAMPLED — the restricted
+    temperature-scaled one (greedy reports the raw softmax logprob).
+    The restriction is computed ONCE and both the draw and the score
+    come from it, so tokens and their reported logprobs cannot
+    desync."""
     if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    l = restrict_logits(logits.astype(jnp.float32) / temperature,
-                        top_k=top_k, top_p=top_p)
-    return jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    else:
+        l = restrict_logits(logits.astype(jnp.float32) / temperature,
+                            top_k=top_k, top_p=top_p)
+        tok = jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
+        lp_all = jax.nn.log_softmax(l, axis=-1)
+    lp = jnp.take_along_axis(lp_all, tok[..., None], -1)[..., 0]
+    return tok, lp
 
 
 @functools.lru_cache(maxsize=64)

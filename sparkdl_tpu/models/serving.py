@@ -96,6 +96,13 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
         return sample_logits(logits, rng, temperature=temperature,
                              top_k=top_k, top_p=top_p)
 
+    def _sample_lp(logits, rng):
+        from sparkdl_tpu.models.generate import sample_logits_with_lp
+
+        return sample_logits_with_lp(
+            logits, rng, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+
     @jax.jit
     def prefill(params, padded_prompt, rng, true_len, adapter_ids=None):
         # standard shared-index decode-mode prefill, batch 1; junk pad
@@ -107,7 +114,8 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
             adapter_ids=adapter_ids, mutable=["cache"],
         )
         last = logits[:, true_len - 1]
-        return state["cache"], _sample(last, rng)
+        tok, lp = _sample_lp(last, rng)
+        return state["cache"], tok, lp
 
     @jax.jit
     def suffix_prefill(params, prefix_cache, padded_suffix, rng,
@@ -120,7 +128,8 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
             adapter_ids=adapter_ids, mutable=["cache"],
         )
         last = logits[:, true_len - 1]
-        return state["cache"], _sample(last, rng)
+        tok, lp = _sample_lp(last, rng)
+        return state["cache"], tok, lp
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def paged_prefill(params, cache, padded_prompt, table_row, rng,
@@ -137,7 +146,8 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
             adapter_ids=adapter_ids, mutable=["cache"],
         )
         last = logits[:, true_len - 1]
-        return state["cache"], _sample(last, rng)
+        tok, lp = _sample_lp(last, rng)
+        return state["cache"], tok, lp
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def copy_pages(cache, src_pages, dst_pages):
@@ -177,7 +187,7 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
                 mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits[:, -1], sub)
+            nxt, lp = _sample_lp(logits[:, -1], sub)
             # inactive slots freeze: position pinned (their junk
             # write is overwritten in place, never visible). Active
             # slots clamp at the last cache row: chunk lengths round
@@ -190,12 +200,12 @@ def _engine_programs(dec_cfg, temperature, sharded_mesh=None, top_k=0,
                 active,
                 jnp.minimum(pos + 1, dec_cfg.max_cache_len - 1),
                 pos)
-            return (st["cache"], nxt, pos, rng), nxt
+            return (st["cache"], nxt, pos, rng), (nxt, lp)
 
-        (cache, token, pos, rng), toks = jax.lax.scan(
+        (cache, token, pos, rng), (toks, lps) = jax.lax.scan(
             body, (cache, token, pos, rng), None, length=n
         )
-        return cache, token, pos, rng, toks  # toks: (n, n_slots)
+        return cache, token, pos, rng, toks, lps  # (n, n_slots) each
 
     return (prefill, suffix_prefill, paged_prefill, insert,
             decode_chunk, copy_pages)
@@ -207,6 +217,7 @@ class _Slot:
     active: bool = False
     remaining: int = 0
     tokens: list = dataclasses.field(default_factory=list)
+    logprobs: list = dataclasses.field(default_factory=list)
 
 
 class ContinuousBatchingEngine:
@@ -317,6 +328,8 @@ class ContinuousBatchingEngine:
         self._stops = {}           # rid -> tuple of stop token tuples
         self._finish_reasons = {}  # rid -> "eos" | "length" | "stop"
         self.finish_reasons = {}   # last drained burst's reasons
+        self._logprobs = {}        # rid -> finished logprob array
+        self.logprobs = {}         # last drained burst's logprobs
         self._next_id = 0
         self.stats = {"steps": 0, "active_slot_steps": 0,
                       "total_slot_steps": 0}
@@ -470,7 +483,7 @@ class ContinuousBatchingEngine:
             table = np.zeros((1, self._max_pages), np.int32)
             table[0, :need] = pages
             padded = _pad_bucket(prefix, self.cfg.max_cache_len)
-            self._cache, _tok = self._paged_prefill_fn(
+            self._cache, _tok, _lp = self._paged_prefill_fn(
                 self.params, self._cache, jnp.asarray(padded),
                 jnp.asarray(table), sub,
                 jnp.asarray(p_len, jnp.int32), jnp.asarray(0, jnp.int32),
@@ -480,7 +493,7 @@ class ContinuousBatchingEngine:
             self._prefixes[pid] = (prefix, pages, adapter_id)
             return pid
         padded = _pad_bucket(prefix, self.cfg.max_cache_len)
-        cache, _ = self._prefill_fn(
+        cache, _, _ = self._prefill_fn(
             self.params, jnp.asarray(padded), sub, p_len,
             adapter_ids=self._adapter_arg(adapter_id),
         )
@@ -638,7 +651,7 @@ class ContinuousBatchingEngine:
         bucket = min(b, self.cfg.max_cache_len - start)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :true_len] = seg_tokens[:true_len]
-        self._cache, tok = self._paged_prefill_fn(
+        self._cache, tok, lp = self._paged_prefill_fn(
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray(self._tables[slot_idx][None]), sub,
             jnp.asarray(true_len, jnp.int32),
@@ -652,7 +665,7 @@ class ContinuousBatchingEngine:
             self._pos = self._pos.at[slot_idx].set(p_len)
             self._token = self._token.at[slot_idx].set(tok[0])
             self._adapter_ids[slot_idx] = adapter_id
-            self._activate_slot(slot_idx, rid, max_new, tok)
+            self._activate_slot(slot_idx, rid, max_new, tok, lp)
 
     def _advance_prefill(self, slot_idx):
         """One more segment for a mid-prefill slot; activates it on
@@ -688,7 +701,7 @@ class ContinuousBatchingEngine:
         (the speculative engine adds its k-token verify scratch)."""
         return p_len + max_new
 
-    def _activate_slot(self, slot_idx, rid, max_new, tok):
+    def _activate_slot(self, slot_idx, rid, max_new, tok, lp):
         """Shared admission epilogue: slot bookkeeping + the
         instant-finish check (first token is eos, or a one-token
         budget) — ONE definition for both admission paths."""
@@ -696,6 +709,7 @@ class ContinuousBatchingEngine:
         s.req_id, s.active = rid, True
         s.remaining = max_new - 1  # the prefill emitted token #1
         s.tokens = [int(np.asarray(tok)[0])]
+        s.logprobs = [float(np.asarray(lp)[0])]
         if self._on_token is not None:
             self._on_token(rid, s.tokens[0])
         if self.eos_id is not None and s.tokens[0] == self.eos_id:
@@ -714,7 +728,7 @@ class ContinuousBatchingEngine:
             suffix = prompt[len(prefix):]
             padded = _pad_bucket(
                 suffix, self.cfg.max_cache_len - len(prefix))
-            one_cache, tok = self._suffix_prefill_fn(
+            one_cache, tok, lp = self._suffix_prefill_fn(
                 self.params, prefix_cache, jnp.asarray(padded), sub,
                 len(suffix),
                 adapter_ids=self._adapter_arg(adapter_id),
@@ -723,7 +737,7 @@ class ContinuousBatchingEngine:
                 self.stats.get("prefill_tokens_saved", 0) + len(prefix))
         else:
             padded = _pad_bucket(prompt, self.cfg.max_cache_len)
-            one_cache, tok = self._prefill_fn(
+            one_cache, tok, lp = self._prefill_fn(
                 self.params, jnp.asarray(padded), sub, p_len,
                 adapter_ids=self._adapter_arg(adapter_id),
             )
@@ -732,15 +746,17 @@ class ContinuousBatchingEngine:
             p_len, slot_idx,
         )
         self._adapter_ids[slot_idx] = adapter_id
-        self._activate_slot(slot_idx, rid, max_new, tok)
+        self._activate_slot(slot_idx, rid, max_new, tok, lp)
 
     def _finish(self, slot_idx, reason="length"):
         s = self._slots[slot_idx]
         self._results[s.req_id] = np.asarray(s.tokens, np.int32)
         self._finish_reasons[s.req_id] = reason
+        self._logprobs[s.req_id] = np.asarray(s.logprobs, np.float32)
         self._stops.pop(s.req_id, None)
         s.active = False
         s.tokens = []
+        s.logprobs = []
         if self.page_size:
             self._free_pages.extend(self._slot_pages[slot_idx])
             self._slot_pages[slot_idx] = []
@@ -790,7 +806,7 @@ class ContinuousBatchingEngine:
                 n *= 2
             n = min(n, self.chunk)
             (self._cache, self._token, self._pos, self._rng,
-             toks) = self._decode_chunk_fn(
+             toks, lps) = self._decode_chunk_fn(
                 self.params, self._cache, self._token, self._pos,
                 jnp.asarray(active), self._rng, n,
                 # non-active rows masked to the dump page: a
@@ -803,12 +819,13 @@ class ContinuousBatchingEngine:
                              if self.cfg.multi_lora else None),
             )
             toks = np.asarray(toks)                 # (n, n_slots)
+            lps = np.asarray(lps)
             self.stats["steps"] += n
             self.stats["total_slot_steps"] += n * self.n_slots
             self.stats["active_slot_steps"] += int(active.sum()) * n
             for i, s in enumerate(self._slots):
                 if s.active:
-                    self._accept_tokens(i, toks[:, i])
+                    self._accept_tokens(i, toks[:, i], lps[:, i])
             if progress is not None:
                 progress(self)
         return self._drain_results()
@@ -847,15 +864,16 @@ class ContinuousBatchingEngine:
                     "left to drain — raise n_pages"
                 )
 
-    def _accept_tokens(self, slot_idx, tokens):
+    def _accept_tokens(self, slot_idx, tokens, logprobs):
         """Append generated tokens to a slot (streaming callback, eos
         and budget enforcement). Returns True when the slot finished —
         trailing tokens past eos/budget are discarded. ONE definition
         shared by the chunked and the speculative decode loops."""
         s = self._slots[slot_idx]
         stops = self._stops.get(s.req_id, ())
-        for t in tokens:
+        for t, lp in zip(tokens, logprobs):
             s.tokens.append(int(t))
+            s.logprobs.append(float(lp))
             s.remaining -= 1
             if self._on_token is not None:
                 self._on_token(s.req_id, int(t))
@@ -879,6 +897,8 @@ class ContinuousBatchingEngine:
         )
         self.finish_reasons = self._finish_reasons
         self._finish_reasons = {}
+        self.logprobs = self._logprobs
+        self._logprobs = {}
         out = self._results
         self._results = {}
         return out
@@ -1015,12 +1035,19 @@ def _spec_engine_programs(dec_cfg, draft_cfg, k, temperature, top_k=0,
             final = jnp.take_along_axis(
                 greedy, m[:, None], axis=1)[:, 0]
             tokens, counts = assemble_round(prop, m, final)
+            lp_all = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1)
         else:
             rng, s_rng = jax.random.split(rng)
             p_probs = _restricted_probs(logits)
             tokens, counts = spec_sample_tokens(
                 q_probs.transpose(1, 0, 2), p_probs, prop, s_rng)
-        return st["cache"], d_cache, tokens, counts, rng
+            lp_all = jnp.log(jnp.maximum(p_probs, 1e-30))
+        # chosen-token logprob under the TARGET distribution at each
+        # verified position (the same convention as _sample_lp)
+        lps = jnp.take_along_axis(
+            lp_all, tokens[..., None], axis=-1)[..., 0]   # (b, k+1)
+        return st["cache"], d_cache, tokens, counts, lps, rng
 
     return draft_prefill, draft_insert, draft_suffix_prefill, spec_round
 
@@ -1190,7 +1217,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             if not active.any():
                 self._deadend_check()
                 continue
-            (self._cache, self._d_cache, tokens, counts,
+            (self._cache, self._d_cache, tokens, counts, lps,
              self._rng) = spec_round(
                 self.params, self._cache, self.draft_params,
                 self._d_cache, self._token, self._pos,
@@ -1201,6 +1228,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             )
             tokens = np.asarray(tokens)               # (b, k+1)
             counts = np.asarray(counts)               # (b,)
+            lps = np.asarray(lps)
             n_act = int(active.sum())
             self.stats["rounds"] += 1
             self.stats["proposed"] += self.k * n_act
@@ -1217,7 +1245,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 # bonus (full acceptance) or the corrected/resampled
                 # one (first rejection)
                 self.stats["accepted"] += cnt - 1
-                if not self._accept_tokens(i, tokens[i, :cnt]):
+                if not self._accept_tokens(i, tokens[i, :cnt],
+                                           lps[i, :cnt]):
                     new_pos[i] += cnt
                     new_tok[i] = tokens[i, cnt - 1]
             self._pos = jnp.asarray(new_pos)

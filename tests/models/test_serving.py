@@ -930,3 +930,39 @@ def test_stop_sequences_on_speculative_engine(setup):
     out = eng.run()
     np.testing.assert_array_equal(out[rid], ref[:6])
     assert eng.finish_reasons[rid] == "stop"
+
+
+def test_logprobs_match_forward_log_softmax(setup):
+    """Greedy logprobs reported per token must equal the raw
+    log-softmax of a full forward over [prompt + generated] at each
+    generation position — the number a serving API calls 'logprob of
+    the chosen token'. Both engines, same convention."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(71)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    n_new = 8
+
+    def oracle_logprobs(tokens_out):
+        full = np.concatenate([p, tokens_out])
+        logits = model.apply({"params": params},
+                             jnp.asarray(full[None, :-1]))
+        lp = jax.nn.log_softmax(np.asarray(logits, np.float32), -1)
+        # generation position i predicts full[len(p)+i]
+        return np.array([
+            lp[0, len(p) - 1 + i, tokens_out[i]]
+            for i in range(len(tokens_out))
+        ])
+
+    for eng in (
+        ContinuousBatchingEngine(model, params, n_slots=2, chunk=4),
+        SpeculativeBatchingEngine(model, params, params, n_slots=2,
+                                  k=3),
+    ):
+        rid = eng.submit(p, n_new)
+        out = eng.run()
+        got = eng.logprobs[rid]
+        assert got.shape == (n_new,)
+        want = oracle_logprobs(out[rid])
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
