@@ -84,8 +84,9 @@ def _decode_programs(dec_cfg, temperature, top_k=0, top_p=1.0):
     dec_model = Llama(dec_cfg)
 
     def _next_token(logits, rng):
-        return sample_logits(logits, rng, temperature=temperature,
-                             top_k=top_k, top_p=top_p)
+        return sample_logits_with_lp(
+            logits, rng, temperature=temperature, top_k=top_k,
+            top_p=top_p)
 
     @jax.jit
     def prefill(params, tokens, rng):
@@ -93,8 +94,8 @@ def _decode_programs(dec_cfg, temperature, top_k=0, top_p=1.0):
             {"params": params}, tokens, mutable=["cache"],
         )
         rng, sub = jax.random.split(rng)
-        token = _next_token(logits[:, -1], sub)
-        return state["cache"], token, rng
+        token, lp = _next_token(logits[:, -1], sub)
+        return state["cache"], token, lp, rng
 
     @functools.partial(jax.jit, static_argnums=(4,))
     def decode_loop(params, cache, token, rng, n_steps):
@@ -105,20 +106,20 @@ def _decode_programs(dec_cfg, temperature, top_k=0, top_p=1.0):
                 mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
-            nxt = _next_token(logits[:, -1], sub)
-            return (state["cache"], nxt, rng), nxt
+            nxt, lp = _next_token(logits[:, -1], sub)
+            return (state["cache"], nxt, rng), (nxt, lp)
 
-        (cache, token, rng), toks = jax.lax.scan(
+        (cache, token, rng), (toks, lps) = jax.lax.scan(
             body, (cache, token, rng), None, length=n_steps
         )
-        return cache, toks  # toks: (n_steps, batch)
+        return cache, toks, lps  # (n_steps, batch) each
 
     return prefill, decode_loop
 
 
 def generate(model, params, prompt_tokens, *, max_new_tokens=32,
              temperature=0.0, top_k=0, top_p=1.0, rng=None,
-             eos_id=None):
+             eos_id=None, return_logprobs=False):
     """Generate continuations.
 
     :param model: a Llama (training or decode config — a decode-mode
@@ -127,8 +128,12 @@ def generate(model, params, prompt_tokens, *, max_new_tokens=32,
     :param top_k: sample only among the k most likely tokens (0 = all).
     :param top_p: nucleus sampling — the minimal top mass kept
         (1.0 = all). Both restrictions need ``temperature > 0``.
+    :param return_logprobs: also return (batch, n) logprobs of the
+        generated tokens under the distribution actually sampled
+        (the serving engines' convention).
     :return: (batch, prompt_len + n) tokens, n <= max_new_tokens
-        (shorter when every row has emitted ``eos_id``).
+        (shorter when every row has emitted ``eos_id``); with
+        ``return_logprobs`` a ``(tokens, logprobs)`` pair.
     """
     prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
     b, p_len = prompt_tokens.shape
@@ -145,16 +150,18 @@ def generate(model, params, prompt_tokens, *, max_new_tokens=32,
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    cache, token, rng = prefill(params, prompt_tokens, rng)
+    cache, token, lp0, rng = prefill(params, prompt_tokens, rng)
     if max_new_tokens > 1:
-        _, scanned = decode_loop(
+        _, scanned, lps = decode_loop(
             params, cache, token, rng, max_new_tokens - 1
         )
         new_tokens = jnp.concatenate(
             [token[:, None], scanned.T], axis=1
         )  # (b, max_new_tokens)
+        new_lps = jnp.concatenate([lp0[:, None], lps.T], axis=1)
     else:
         new_tokens = token[:, None]
+        new_lps = lp0[:, None]
 
     if eos_id is not None:
         # Early-stop semantics of a step-by-step loop: truncate after
@@ -170,5 +177,9 @@ def generate(model, params, prompt_tokens, *, max_new_tokens=32,
         hits = np.flatnonzero(all_eos)
         if hits.size:
             new_tokens = new_tokens[:, :int(hits[0]) + 2]
+            new_lps = new_lps[:, :new_tokens.shape[1]]
 
-    return jnp.concatenate([prompt_tokens, new_tokens], axis=1)
+    out = jnp.concatenate([prompt_tokens, new_tokens], axis=1)
+    if return_logprobs:
+        return out, new_lps
+    return out

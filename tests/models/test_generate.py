@@ -188,3 +188,22 @@ def test_generate_top_k_one_equals_greedy():
     topk1 = generate(model, params, prompt, max_new_tokens=8,
                      temperature=0.9, top_k=1)
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+
+def test_generate_return_logprobs_matches_forward():
+    """generate(return_logprobs=True): greedy per-token logprobs must
+    equal the raw log-softmax of a full forward at each generation
+    position (same convention as the serving engines)."""
+    cfg, model, params, prompt = _setup()
+    out, lps = generate(model, params, prompt, max_new_tokens=6,
+                        temperature=0.0, return_logprobs=True)
+    out, lps = np.asarray(out), np.asarray(lps)
+    assert lps.shape == (out.shape[0], 6)
+    logits = model.apply({"params": params}, jnp.asarray(out[:, :-1]))
+    ref = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits, np.float32), -1))
+    p_len = prompt.shape[1]
+    for b in range(out.shape[0]):
+        for i in range(6):
+            want = ref[b, p_len - 1 + i, out[b, p_len + i]]
+            np.testing.assert_allclose(lps[b, i], want, atol=2e-4)
