@@ -966,3 +966,19 @@ def test_logprobs_match_forward_log_softmax(setup):
         assert got.shape == (n_new,)
         want = oracle_logprobs(out[rid])
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_parallel_sampling_same_prompt_diverges(setup):
+    """n-samples-per-prompt needs no engine feature: submitting the
+    same prompt twice at temperature > 0 occupies two slots whose
+    categorical draws are independent across batch rows — outputs
+    (almost surely) diverge, budgets hold."""
+    cfg, model, params = setup
+    rng = np.random.default_rng(73)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4,
+                                   temperature=1.0)
+    r1, r2 = eng.submit(p, 16), eng.submit(p, 16)
+    out = eng.run()
+    assert len(out[r1]) == len(out[r2]) == 16
+    assert not np.array_equal(out[r1], out[r2])
