@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""The reference's canonical workflow: HorovodRunner + a Keras CNN
+(reference runner_base.py docstring examples) — runs as-is on CPU or a
+TPU host. np=-1 trains in-process; np=-3 launches a 3-rank local gang
+whose gradients average over the XLA collective engine.
+
+    python examples/horovod_runner_mnist.py [np]
+"""
+
+import sys
+
+
+def train():
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod.tensorflow.keras as hvd
+    from sparkdl.horovod.tensorflow.keras import LogCallback
+
+    hvd.init()
+    tf.random.set_seed(42 + hvd.rank())
+    model = tf.keras.Sequential([
+        tf.keras.Input(shape=(28, 28, 1)),
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10),
+    ])
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            tf.keras.optimizers.Adam(1e-3)),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+    )
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(512, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, 512).astype("int32")
+    hist = model.fit(
+        x, y, batch_size=64, epochs=1, verbose=0,
+        callbacks=[hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                   LogCallback()],
+    )
+    return {"rank": hvd.rank(), "size": hvd.size(),
+            "loss": float(hist.history["loss"][-1])}
+
+
+if __name__ == "__main__":
+    from sparkdl import HorovodRunner
+
+    np_arg = int(sys.argv[1]) if len(sys.argv) > 1 else -1
+    print("RESULT:", HorovodRunner(np=np_arg).run(train))
