@@ -1,0 +1,257 @@
+"""HTTP front-end for the continuous-batching engines: token-id JSON
+in, token-id JSON (or an SSE token stream) out.
+
+Scope: the SERVICE plumbing around an engine — request queueing across
+bursts, per-request streaming, clean shutdown — on the stdlib only
+(deployments put their own gateway in front; zero new dependencies,
+matching the package's optional-dependency posture). Tokenization is
+deliberately out of scope: the wire format is token ids, the model's
+native interface.
+
+Threading model: every engine method runs on ONE engine thread (JAX
+state, program caches, and the engine's host bookkeeping are not
+thread-safe); HTTP handler threads only enqueue work and wait. The
+engine thread drains arrivals into ``engine.submit`` (host-side
+bookkeeping only), calls ``run()`` — during which NEW arrivals still
+land mid-burst through the engine's own admission loop via
+``_poll_queue`` — and posts results to per-request mailboxes.
+
+API::
+
+    POST /generate  {"tokens": [...], "max_new_tokens": 32,
+                     "stop": [[...]], "stream": false}
+      -> {"tokens": [...], "finish_reason": "...", "logprobs": [...]}
+      stream=true  -> text/event-stream, one ``data: {"token": t}``
+      event per generated token, then ``data: {"done": ...}``.
+    GET /health -> {"status": "ok", "queued": N}
+
+No reference counterpart (the reference is a training-launcher stub);
+this completes the serving story: model -> engine -> service.
+"""
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Mailbox:
+    """Per-request rendezvous between the engine thread and one HTTP
+    handler thread: a token stream and a final-result event."""
+
+    def __init__(self):
+        self.tokens = queue.Queue()
+        self.done = threading.Event()
+        self.result = None           # (tokens, finish_reason, logprobs)
+        self.error = None
+
+
+class ServingFrontend:
+    """Run an engine behind an HTTP server.
+
+    ``engine``: a ContinuousBatchingEngine / SpeculativeBatchingEngine
+    (constructed by the caller — model choice, paging, speculation and
+    sampling knobs all live there). ``start()`` spawns the engine and
+    HTTP threads; ``close()`` stops both.
+    """
+
+    def __init__(self, engine, host="127.0.0.1", port=0):
+        self.engine = engine
+        self._arrivals = queue.Queue()   # (request dict, _Mailbox)
+        self._live = {}                  # rid -> _Mailbox
+        self._shutdown = threading.Event()
+        self._wake = threading.Event()
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="sparkdl-engine", daemon=True)
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet by default
+                pass
+
+            def do_GET(self):
+                if self.path != "/health":
+                    self.send_error(404)
+                    return
+                body = json.dumps({
+                    "status": "ok",
+                    "queued": frontend._arrivals.qsize(),
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self.send_error(404)
+                    return
+                # Parse and validate ONCE, synchronously, before any
+                # status line — the streamed and blocking paths must
+                # reject the same inputs with the same 400 (an SSE
+                # response has already committed 200 by the time the
+                # engine could complain).
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    parsed = {
+                        "tokens": [int(t) for t in req["tokens"]],
+                        "max_new_tokens": int(
+                            req.get("max_new_tokens", 32)),
+                        "stop": req.get("stop"),
+                    }
+                    if parsed["max_new_tokens"] < 1:
+                        raise ValueError("max_new_tokens must be >= 1")
+                    worst = frontend.engine._worst_case_tokens(
+                        len(parsed["tokens"]), parsed["max_new_tokens"])
+                    if worst > frontend.engine.cfg.max_cache_len:
+                        raise ValueError(
+                            f"prompt + budget ({worst}) exceeds "
+                            f"max_cache_len "
+                            f"({frontend.engine.cfg.max_cache_len})")
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self.send_error(400, str(e))
+                    return
+                box = _Mailbox()
+                frontend._arrivals.put((parsed, box))
+                frontend._wake.set()
+                if req.get("stream"):
+                    self._stream(box)
+                else:
+                    box.done.wait()
+                    self._respond(box)
+
+            def _respond(self, box):
+                if box.error is not None:
+                    self.send_error(400, box.error)
+                    return
+                toks, reason, lps = box.result
+                body = json.dumps({
+                    "tokens": [int(t) for t in toks],
+                    "finish_reason": reason,
+                    "logprobs": [float(v) for v in lps],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _stream(self, box):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                while True:
+                    tok = box.tokens.get()
+                    if tok is None:              # engine says done
+                        break
+                    self.wfile.write(
+                        b"data: " + json.dumps({"token": tok}).encode()
+                        + b"\n\n")
+                    self.wfile.flush()
+                if box.error is not None:
+                    tail = {"error": box.error}
+                else:
+                    tail = {"done": box.result[1]}
+                self.wfile.write(
+                    b"data: " + json.dumps(tail).encode() + b"\n\n")
+                self.wfile.flush()
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+
+    # -- engine thread -----------------------------------------------
+
+    def _poll_queue(self, _engine):
+        """Pull arrivals into engine.submit — called between bursts
+        AND from run()'s progress hook, so requests arriving mid-burst
+        are admitted as soon as a slot frees instead of waiting for
+        the burst to drain."""
+        while True:
+            try:
+                req, box = self._arrivals.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                rid = self.engine.submit(
+                    req["tokens"], req["max_new_tokens"],
+                    stop=req["stop"],
+                )
+                self._live[rid] = box
+            except (ValueError, TypeError) as e:
+                # backstop: do_POST pre-validates, but engine-specific
+                # constraints (adapters, prefixes) can still refuse
+                box.error = str(e)
+                box.tokens.put(None)
+                box.done.set()
+
+    def _engine_loop(self):
+        try:
+            self._serve_bursts()
+        finally:
+            # shutdown (or a loop crash) must not strand handler
+            # threads on untimed waits: fail every outstanding mailbox
+            self._poll_queue(self.engine)  # pull stragglers out of
+            for box in self._live.values():    # _arrivals first
+                box.error = "server shutting down"
+                box.tokens.put(None)
+                box.done.set()
+            self._live.clear()
+
+    def _serve_bursts(self):
+        def on_token(rid, tok):
+            box = self._live.get(rid)
+            if box is not None:
+                box.tokens.put(int(tok))
+
+        while not self._shutdown.is_set():
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            self._poll_queue(self.engine)
+            if not self._live and self._arrivals.empty():
+                continue
+            try:
+                results = self.engine.run(progress=self._poll_queue,
+                                          on_token=on_token)
+            except Exception as e:  # engine fault: fail the waiters
+                for box in self._live.values():   # and keep serving
+                    box.error = f"engine error: {e}"
+                    box.tokens.put(None)
+                    box.done.set()
+                self._live.clear()
+                # the engine still holds the poison request (queued or
+                # mid-slot); without this a deterministic fault would
+                # re-fire on every later burst and the server would
+                # never recover
+                self.engine.abort_requests()
+                continue
+            for rid, toks in results.items():
+                box = self._live.pop(rid, None)
+                if box is None:
+                    continue
+                box.result = (
+                    toks.tolist(),
+                    self.engine.finish_reasons.get(rid, "length"),
+                    self.engine.logprobs.get(rid, []),
+                )
+                box.tokens.put(None)
+                box.done.set()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self):
+        self._engine_thread.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="sparkdl-http",
+            daemon=True)
+        self._http_thread.start()
+        return self
+
+    def close(self):
+        self._shutdown.set()
+        self._wake.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._engine_thread.join(timeout=30)

@@ -888,6 +888,31 @@ class ContinuousBatchingEngine:
                 return True
         return False
 
+    def abort_requests(self):
+        """Discard every queued and active request WITHOUT producing
+        results — service fault recovery (models/server.py): after a
+        run() fault the engine may hold a poison request queued or
+        mid-slot, and re-running it would re-fire the fault forever.
+        Frees paged pool pages and deactivates slots; abandoned cache
+        rows are junk that later admissions overwrite (the same
+        invariant slot reuse already relies on)."""
+        self._queue.clear()
+        self._prefilling.clear()
+        self._stops.clear()
+        self._finish_reasons.clear()
+        self._logprobs.clear()
+        self._results.clear()
+        for i, s in enumerate(self._slots):
+            if self.page_size and self._slot_pages[i]:
+                self._free_pages.extend(self._slot_pages[i])
+                self._slot_pages[i] = []
+                self._tables[i] = 0
+            s.active = False
+            s.req_id = -1
+            s.remaining = 0
+            s.tokens = []
+            s.logprobs = []
+
     def _drain_results(self):
         """Final stats + hand the burst's results to the caller;
         per-request finish causes land in :attr:`finish_reasons`."""

@@ -1,0 +1,183 @@
+"""HTTP front-end: token-id JSON in/out over a live engine — blocking
+and SSE-streamed requests, concurrent clients, error paths, and
+exactness against the single-stream oracle."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models import Llama, LlamaConfig
+from sparkdl_tpu.models.generate import generate
+from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+from sparkdl_tpu.models.server import ServingFrontend
+
+
+@pytest.fixture(scope="module")
+def frontend():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96)
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4)
+    fe = ServingFrontend(eng).start()
+    yield fe, cfg, model, params
+    fe.close()
+
+
+def _post(fe, payload):
+    req = urllib.request.Request(
+        f"http://{fe.address[0]}:{fe.address[1]}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+def test_generate_endpoint_matches_oracle(frontend):
+    fe, cfg, model, params = frontend
+    rng = np.random.default_rng(1)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    out = _post(fe, {"tokens": p.tolist(), "max_new_tokens": 8})
+    oracle = generate(model, params, p[None], max_new_tokens=8,
+                      temperature=0.0)
+    assert out["tokens"] == np.asarray(oracle)[0, 6:].tolist()
+    assert out["finish_reason"] == "length"
+    assert len(out["logprobs"]) == 8
+
+
+def test_concurrent_clients_one_burst(frontend):
+    fe, cfg, model, params = frontend
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 7, 9)]
+    results = [None] * 3
+
+    def client(i):
+        results[i] = _post(fe, {"tokens": prompts[i].tolist(),
+                                "max_new_tokens": 6})
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i, p in enumerate(prompts):
+        oracle = generate(model, params, p[None], max_new_tokens=6,
+                          temperature=0.0)
+        assert results[i]["tokens"] == \
+            np.asarray(oracle)[0, len(p):].tolist()
+
+
+def test_streaming_sse(frontend):
+    fe, cfg, model, params = frontend
+    rng = np.random.default_rng(3)
+    p = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    req = urllib.request.Request(
+        f"http://{fe.address[0]}:{fe.address[1]}/generate",
+        data=json.dumps({"tokens": p.tolist(), "max_new_tokens": 5,
+                         "stream": True}).encode(),
+    )
+    events = []
+    with urllib.request.urlopen(req, timeout=300) as r:
+        for line in r:
+            line = line.strip()
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+    assert events[-1] == {"done": "length"}
+    streamed = [e["token"] for e in events[:-1]]
+    oracle = generate(model, params, p[None], max_new_tokens=5,
+                      temperature=0.0)
+    assert streamed == np.asarray(oracle)[0, 5:].tolist()
+
+
+def test_bad_request_is_400_not_a_hang(frontend):
+    fe, *_ = frontend
+    # oversized budget: engine.submit raises; the mailbox must carry
+    # the error back instead of wedging the client
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(fe, {"tokens": [1, 2, 3], "max_new_tokens": 10_000})
+    assert e.value.code == 400
+    # malformed body
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req = urllib.request.Request(
+            f"http://{fe.address[0]}:{fe.address[1]}/generate",
+            data=b"{not json")
+        urllib.request.urlopen(req, timeout=60)
+    assert e.value.code == 400
+
+
+def test_health(frontend):
+    fe, *_ = frontend
+    with urllib.request.urlopen(
+            f"http://{fe.address[0]}:{fe.address[1]}/health",
+            timeout=60) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_engine_fault_recovery():
+    """A burst that faults must fail ONLY its waiters and leave the
+    server healthy: the poison request is aborted out of the engine
+    (abort_requests) so the next burst serves normally."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    class FaultOnce(ContinuousBatchingEngine):
+        faults = [True]
+
+        def _run(self, progress):
+            if self.faults:
+                self.faults.pop()
+                raise RuntimeError("injected fault")
+            return super()._run(progress)
+
+    fe = ServingFrontend(FaultOnce(model, params, n_slots=2,
+                                   chunk=4)).start()
+    try:
+        p = np.arange(1, 7, dtype=np.int32)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(fe, {"tokens": p.tolist(), "max_new_tokens": 4})
+        assert e.value.code == 400
+        assert "engine error" in str(e.value.reason)
+        # server recovered: the next request serves correctly
+        out = _post(fe, {"tokens": p.tolist(), "max_new_tokens": 4})
+        oracle = generate(model, params, p[None], max_new_tokens=4,
+                          temperature=0.0)
+        assert out["tokens"] == np.asarray(oracle)[0, 6:].tolist()
+    finally:
+        fe.close()
+
+
+def test_stream_bad_request_is_400_too():
+    """The streamed path must reject invalid requests with the SAME
+    400 the blocking path gives — never a 200 + SSE error event."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    fe = ServingFrontend(ContinuousBatchingEngine(
+        model, params, n_slots=2, chunk=4)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(fe, {"tokens": [1, 2], "max_new_tokens": 10_000,
+                       "stream": True})
+        assert e.value.code == 400
+        # non-object JSON: 400, not a dropped connection
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req = urllib.request.Request(
+                f"http://{fe.address[0]}:{fe.address[1]}/generate",
+                data=json.dumps([1, 2, 3]).encode())
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 400
+    finally:
+        fe.close()
