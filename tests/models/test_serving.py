@@ -982,3 +982,46 @@ def test_parallel_sampling_same_prompt_diverges(setup):
     out = eng.run()
     assert len(out[r1]) == len(out[r2]) == 16
     assert not np.array_equal(out[r1], out[r2])
+
+
+def test_randomized_request_stream_paged_spec(setup):
+    """Property test over the deepest composition (paged target +
+    speculative + stops + ragged budgets): a fixed-seed random stream
+    of 8 requests through 3 slots must match the single-stream oracle
+    request-for-request, with the page pool fully returned. One seed,
+    bounded runtime — the per-mode suites isolate failures; this
+    catches interactions between admission, acceptance, stops, and
+    page recycling that no single-mode test composes."""
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(2026)
+    eng = SpeculativeBatchingEngine(
+        model, params, params, n_slots=3, k=3, page_size=16)
+    reqs = []
+    for _ in range(8):
+        p = rng.integers(0, cfg.vocab_size,
+                         (int(rng.integers(3, 14)),)).astype(np.int32)
+        budget = int(rng.integers(2, 24))
+        oracle = _oracle(model, params, p, budget)
+        stop = None
+        if rng.random() < 0.4 and len(oracle) >= 4:
+            j = int(rng.integers(1, len(oracle) - 1))
+            stop = [[int(oracle[j]), int(oracle[j + 1])]]
+        reqs.append((eng.submit(p, budget, stop=stop), p, budget,
+                     oracle, stop))
+    out = eng.run()
+    for rid, p, budget, oracle, stop in reqs:
+        got = out[rid]
+        if stop is not None:
+            # output ends at (and includes) the stop pair if it fired
+            want = oracle
+            s = stop[0]
+            for i in range(1, len(oracle)):
+                if [int(oracle[i - 1]), int(oracle[i])] == s:
+                    want = oracle[:i + 1]
+                    break
+            np.testing.assert_array_equal(got, want)
+        else:
+            np.testing.assert_array_equal(got, oracle)
+    assert len(eng._free_pages) == eng.cfg.n_pages - 1  # all returned
