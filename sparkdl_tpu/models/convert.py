@@ -1,0 +1,92 @@
+"""HuggingFace Llama checkpoint → sparkdl-tpu param tree.
+
+A model zoo is only as useful as the weights you can load into it:
+``params_from_hf`` maps a ``transformers`` ``LlamaForCausalLM`` state
+dict (torch tensors or numpy arrays) onto :class:`~sparkdl_tpu.models.
+llama.Llama`'s flax tree, and ``config_from_hf`` derives the matching
+:class:`LlamaConfig`. The architectures agree convention-for-
+convention (half-split RoPE rotation, SwiGLU gate/up/down, pre-norm
+RMS, GQA head grouping), so conversion is pure renaming plus the
+torch→flax kernel transpose — and the parity test
+(tests/models/test_hf_convert.py) pins OUR forward against the HF
+torch forward on the same random weights, the strongest offline
+correctness statement a reimplementation can make.
+
+Torch stores ``Linear`` weights (out, in); flax ``Dense`` kernels are
+(in, out) — every projection transposes. ``tie_word_embeddings``
+checkpoints have no ``lm_head.weight``; the embedding matrix is used.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def config_from_hf(hf_config, **overrides):
+    """LlamaConfig from a ``transformers.LlamaConfig`` (or any object
+    with the same attribute names)."""
+    from sparkdl_tpu.models.llama import LlamaConfig
+
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rms_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def _np(t):
+    """torch tensor / numpy array → float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def params_from_hf(state_dict, cfg, dtype=None):
+    """Map an HF Llama state dict onto the flax tree ``Llama(cfg)``
+    expects. ``state_dict``: ``model.state_dict()`` from a
+    ``LlamaForCausalLM`` (keys ``model.embed_tokens.weight``, ...).
+    ``dtype``: cast 2-D kernels (default: keep fp32; pass
+    ``jnp.bfloat16`` for serving trees)."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def dense(key):
+        return jnp.asarray(sd[key].T, dtype or jnp.float32)
+
+    params = {
+        "embed": {"embedding": jnp.asarray(
+            sd["model.embed_tokens.weight"], dtype or jnp.float32)},
+        "final_norm": {"scale": jnp.asarray(sd["model.norm.weight"])},
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": jnp.asarray(
+            sd["lm_head.weight"].T, jnp.float32)}
+    else:  # tie_word_embeddings
+        params["lm_head"] = {"kernel": jnp.asarray(
+            sd["model.embed_tokens.weight"].T, jnp.float32)}
+    for i in range(cfg.n_layers):
+        hf = f"model.layers.{i}"
+        params[f"layer_{i}"] = {
+            "attn": {
+                "q_proj": {"kernel": dense(f"{hf}.self_attn.q_proj.weight")},
+                "k_proj": {"kernel": dense(f"{hf}.self_attn.k_proj.weight")},
+                "v_proj": {"kernel": dense(f"{hf}.self_attn.v_proj.weight")},
+                "o_proj": {"kernel": dense(f"{hf}.self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": dense(f"{hf}.mlp.gate_proj.weight")},
+                "up_proj": {"kernel": dense(f"{hf}.mlp.up_proj.weight")},
+                "down_proj": {"kernel": dense(f"{hf}.mlp.down_proj.weight")},
+            },
+            "attn_norm": {"scale": jnp.asarray(
+                sd[f"{hf}.input_layernorm.weight"])},
+            "mlp_norm": {"scale": jnp.asarray(
+                sd[f"{hf}.post_attention_layernorm.weight"])},
+        }
+    return params
