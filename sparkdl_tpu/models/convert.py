@@ -90,3 +90,32 @@ def params_from_hf(state_dict, cfg, dtype=None):
                 sd[f"{hf}.post_attention_layernorm.weight"])},
         }
     return params
+
+
+def params_to_hf(params, cfg):
+    """Inverse of :func:`params_from_hf`: export a (LoRA-merged) tree
+    as an HF Llama state dict of numpy arrays — load it with
+    ``LlamaForCausalLM.load_state_dict`` (after ``torch.from_numpy``)
+    to hand a fine-tune back to the HF ecosystem."""
+    def w(leaf):
+        return np.asarray(leaf, np.float32)
+
+    sd = {
+        "model.embed_tokens.weight": w(params["embed"]["embedding"]),
+        "model.norm.weight": w(params["final_norm"]["scale"]),
+        "lm_head.weight": w(params["lm_head"]["kernel"]).T,
+    }
+    for i in range(cfg.n_layers):
+        ours = params[f"layer_{i}"]
+        hf = f"model.layers.{i}"
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[f"{hf}.self_attn.{name}.weight"] = \
+                w(ours["attn"][name]["kernel"]).T
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[f"{hf}.mlp.{name}.weight"] = \
+                w(ours["mlp"][name]["kernel"]).T
+        sd[f"{hf}.input_layernorm.weight"] = \
+            w(ours["attn_norm"]["scale"])
+        sd[f"{hf}.post_attention_layernorm.weight"] = \
+            w(ours["mlp_norm"]["scale"])
+    return sd

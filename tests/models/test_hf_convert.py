@@ -71,3 +71,35 @@ def test_tied_embeddings_checkpoint(hf_pair):
     emb = np.asarray(p2["embed"]["embedding"])
     np.testing.assert_array_equal(
         np.asarray(p2["lm_head"]["kernel"]), emb.T)
+
+
+def test_roundtrip_and_export_to_hf(hf_pair):
+    """ours -> HF -> ours is identity, and a tree EXPORTED to HF runs
+    in the torch model with logits matching our forward — the
+    fine-tune handoff direction."""
+    from sparkdl_tpu.models.convert import params_to_hf
+
+    hf_model, cfg, params = hf_pair
+    sd = params_to_hf(params, cfg)
+    back = params_from_hf(sd, cfg)
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree.flatten_with_path(params)[0],
+            jax.tree.flatten_with_path(back)[0]):
+        assert jax.tree_util.keystr(p1) == jax.tree_util.keystr(p2)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    # perturb ours (a 'fine-tune'), export, run in torch
+    tuned = jax.tree.map(
+        lambda x: x + 0.01 * jax.random.normal(
+            jax.random.PRNGKey(3), x.shape, x.dtype)
+        if x.ndim == 2 else x, params)
+    hf_model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in params_to_hf(tuned, cfg).items()})
+    rng = np.random.default_rng(4)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 9))
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(Llama(cfg).apply(
+        {"params": tuned}, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
