@@ -103,3 +103,30 @@ def test_roundtrip_and_export_to_hf(hf_pair):
     ours = np.asarray(Llama(cfg).apply(
         {"params": tuned}, jnp.asarray(tokens, jnp.int32)))
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_hf_checkpoint_through_the_serving_stack(hf_pair):
+    """The user journey end to end: HF checkpoint -> convert -> int8
+    draft -> speculative continuous batching -> tokens equal to our
+    single-stream oracle on the same converted weights."""
+    import dataclasses
+
+    from sparkdl_tpu.models.generate import generate
+    from sparkdl_tpu.models.quant import quantize_llama_params
+    from sparkdl_tpu.models.serving import SpeculativeBatchingEngine
+
+    hf_model, cfg, params = hf_pair
+    cfg = dataclasses.replace(cfg, max_cache_len=48)
+    model = Llama(cfg)
+    draft_tree = quantize_llama_params(params)
+    rng = np.random.default_rng(5)
+    p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    eng = SpeculativeBatchingEngine(
+        model, params, draft_tree, n_slots=2, k=3,
+        draft_model=Llama(dataclasses.replace(cfg, quant="int8")))
+    rid = eng.submit(p, 10)
+    out = eng.run()
+    oracle = generate(model, params, p[None], max_new_tokens=10,
+                      temperature=0.0)
+    np.testing.assert_array_equal(out[rid],
+                                  np.asarray(oracle)[0, 6:])
