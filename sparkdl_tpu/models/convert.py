@@ -23,9 +23,19 @@ import numpy as np
 
 def config_from_hf(hf_config, **overrides):
     """LlamaConfig from a ``transformers.LlamaConfig`` (or any object
-    with the same attribute names)."""
+    with the same attribute names). Raises on checkpoints whose RoPE
+    is rescaled (``rope_scaling``) — converting one silently would
+    produce a model that degrades quietly at long context instead of
+    failing loudly here."""
     from sparkdl_tpu.models.llama import LlamaConfig
 
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not supported yet; this "
+            "checkpoint's positional embedding is rescaled and a "
+            "plain-RoPE conversion would be silently wrong"
+        )
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -53,10 +63,18 @@ def params_from_hf(state_dict, cfg, dtype=None):
     expects. ``state_dict``: ``model.state_dict()`` from a
     ``LlamaForCausalLM`` (keys ``model.embed_tokens.weight``, ...).
     ``dtype``: cast 2-D kernels (default: keep fp32; pass
-    ``jnp.bfloat16`` for serving trees)."""
+    ``jnp.bfloat16`` for serving trees).
+
+    Strict: every weight in the state dict must be consumed by the
+    mapping (modulo known harmless buffers) — an attention-bias or
+    otherwise-extended checkpoint converted by silently dropping
+    tensors would be numerically wrong with no error."""
     sd = {k: _np(v) for k, v in state_dict.items()}
+    consumed = set()
+    _HARMLESS = ("rotary_emb.inv_freq", "position_ids")
 
     def dense(key):
+        consumed.add(key)
         return jnp.asarray(sd[key].T, dtype or jnp.float32)
 
     params = {
@@ -64,9 +82,11 @@ def params_from_hf(state_dict, cfg, dtype=None):
             sd["model.embed_tokens.weight"], dtype or jnp.float32)},
         "final_norm": {"scale": jnp.asarray(sd["model.norm.weight"])},
     }
+    consumed.update(("model.embed_tokens.weight", "model.norm.weight"))
     if "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": jnp.asarray(
             sd["lm_head.weight"].T, jnp.float32)}
+        consumed.add("lm_head.weight")
     else:  # tie_word_embeddings
         params["lm_head"] = {"kernel": jnp.asarray(
             sd["model.embed_tokens.weight"].T, jnp.float32)}
@@ -89,6 +109,18 @@ def params_from_hf(state_dict, cfg, dtype=None):
             "mlp_norm": {"scale": jnp.asarray(
                 sd[f"{hf}.post_attention_layernorm.weight"])},
         }
+        consumed.update((f"{hf}.input_layernorm.weight",
+                         f"{hf}.post_attention_layernorm.weight"))
+    leftover = [k for k in sd
+                if k not in consumed
+                and not k.endswith(_HARMLESS)]
+    if leftover:
+        raise ValueError(
+            f"unmapped weights in the HF state dict: {leftover[:6]}"
+            f"{'...' if len(leftover) > 6 else ''} — this checkpoint "
+            "carries tensors (biases? adapters?) the conversion would "
+            "silently drop"
+        )
     return params
 
 

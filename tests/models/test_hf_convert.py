@@ -130,3 +130,23 @@ def test_hf_checkpoint_through_the_serving_stack(hf_pair):
                       temperature=0.0)
     np.testing.assert_array_equal(out[rid],
                                   np.asarray(oracle)[0, 6:])
+
+
+def test_conversion_refuses_what_it_cannot_map(hf_pair):
+    """Unmapped tensors (e.g. attention biases) and rescaled RoPE must
+    raise — a silently-lossy conversion is worse than none."""
+    from sparkdl_tpu.models.convert import config_from_hf
+
+    hf_model, cfg, params = hf_pair
+    sd = dict(hf_model.state_dict())
+    sd["model.layers.0.self_attn.q_proj.bias"] = np.zeros(64, np.float32)
+    with pytest.raises(ValueError, match="unmapped weights"):
+        params_from_hf(sd, cfg)
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+    )
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        config_from_hf(hf_cfg)
