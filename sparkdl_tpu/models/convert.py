@@ -24,19 +24,34 @@ import numpy as np
 def config_from_hf(hf_config, **overrides):
     """LlamaConfig from a ``transformers.LlamaConfig`` (or any object
     with the same attribute names). Raises on checkpoints whose RoPE
-    is rescaled (``rope_scaling``) — converting one silently would
-    produce a model that degrades quietly at long context instead of
-    failing loudly here."""
+    uses an unsupported ``rope_scaling`` kind — converting one
+    silently would produce a model that degrades quietly at long
+    context. ``linear`` and ``llama3`` scalings are translated
+    (rope_freqs implements both, pinned against HF's torch rotary by
+    the parity tests)."""
     from sparkdl_tpu.models.llama import LlamaConfig
 
     scaling = getattr(hf_config, "rope_scaling", None)
+    rope_scaling = None
     if scaling:
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not supported yet; this "
-            "checkpoint's positional embedding is rescaled and a "
-            "plain-RoPE conversion would be silently wrong"
-        )
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind == "linear":
+            rope_scaling = ("linear", float(scaling["factor"]))
+        elif kind == "llama3":
+            rope_scaling = (
+                "llama3", float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                int(scaling["original_max_position_embeddings"]),
+            )
+        else:
+            raise NotImplementedError(
+                f"rope_scaling={scaling!r} is not supported; a "
+                "plain-RoPE conversion of a rescaled checkpoint would "
+                "be silently wrong"
+            )
     kw = dict(
+        rope_scaling=rope_scaling,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,

@@ -31,6 +31,11 @@ class LlamaConfig:
     n_kv_heads: int = 8
     d_ff: int = 14336
     rope_theta: float = 500000.0
+    # RoPE rescaling for long-context checkpoints: None,
+    # ("linear", factor), or ("llama3", factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings) — a TUPLE
+    # (hashable: configs key jit/program caches). See rope_freqs.
+    rope_scaling: Optional[tuple] = None
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
@@ -170,9 +175,34 @@ def _apply_dense(cfg, features, name, x, adapter_ids=None):
     return _dense(cfg, features, name)(x)
 
 
-def rope_freqs(head_dim, max_seq, theta):
+def rope_freqs(head_dim, max_seq, theta, scaling=None):
+    """RoPE cos/sin tables. ``scaling`` (LlamaConfig.rope_scaling):
+    None, ``("linear", factor)`` — positions stretched uniformly — or
+    ``("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_len)`` — Llama-3.1's per-frequency remap: wavelengths
+    short relative to the ORIGINAL training context keep full
+    resolution, long wavelengths stretch by ``factor``, the band
+    between interpolates smoothly (matches HF's
+    _compute_llama3_parameters, pinned by the conversion parity
+    tests)."""
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                       dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        kind = scaling[0]
+        if kind == "linear":
+            inv = inv / scaling[1]
+        elif kind == "llama3":
+            _, factor, low_ff, high_ff, orig_len = scaling
+            wavelen = 2.0 * jnp.pi / inv
+            low_wl = orig_len / low_ff
+            high_wl = orig_len / high_ff
+            smooth = (orig_len / wavelen - low_ff) / (high_ff - low_ff)
+            inv_mid = (1 - smooth) * inv / factor + smooth * inv
+            inv = jnp.where(
+                wavelen < high_wl, inv,
+                jnp.where(wavelen > low_wl, inv / factor, inv_mid))
+        else:
+            raise ValueError(f"unknown rope scaling kind {kind!r}")
     t = jnp.arange(max_seq, dtype=jnp.float32)
     ang = jnp.outer(t, inv)                       # (S, D/2)
     return jnp.cos(ang), jnp.sin(ang)
@@ -490,7 +520,8 @@ class Llama(nn.Module):
         # Static RoPE table covering both training (seq s) and cached
         # decoding (positions < max_cache_len).
         cos, sin = rope_freqs(
-            head_dim, max(s, cfg.max_cache_len), cfg.rope_theta
+            head_dim, max(s, cfg.max_cache_len), cfg.rope_theta,
+            cfg.rope_scaling,
         )
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      name="embed")(tokens)

@@ -146,7 +146,40 @@ def test_conversion_refuses_what_it_cannot_map(hf_pair):
     hf_cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=48,
         num_hidden_layers=1, num_attention_heads=2,
-        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        rope_scaling={"rope_type": "yarn", "factor": 2.0,
+                      "beta_fast": 32, "beta_slow": 1,
+                      "original_max_position_embeddings": 16},
     )
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(hf_cfg)
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "linear", "factor": 2.0},
+    {"rope_type": "llama3", "factor": 4.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_position_embeddings": 32},
+])
+def test_rope_scaled_checkpoints_match_hf(scaling):
+    """linear and llama3 rope scalings: our scaled rope_freqs must
+    reproduce HF's torch rotary exactly — logits parity on a scaled
+    checkpoint at positions past the original context window."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rope_theta=10000.0, rope_scaling=dict(scaling),
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval().float()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, max_cache_len=128)
+    assert cfg.rope_scaling is not None
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    rng = np.random.default_rng(8)
+    # length past the ORIGINAL window so the scaling actually matters
+    tokens = rng.integers(0, cfg.vocab_size, (1, 64))
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(Llama(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
