@@ -123,6 +123,15 @@ class LlamaConfig:
                    n_heads=32, n_kv_heads=8, d_ff=14336, **kw)
 
     @classmethod
+    def llama31_8b(cls, **kw):
+        """Llama-3.1-8B: the 3.0 architecture + the official llama3
+        RoPE rescale (factor 8 over the 8192-token original window)
+        that buys the 128k context."""
+        kw.setdefault("rope_scaling",
+                      ("llama3", 8.0, 1.0, 4.0, 8192))
+        return cls.llama3_8b(**kw)
+
+    @classmethod
     def tiny(cls, **kw):
         """CI-size config (full architecture, small dims)."""
         defaults = dict(vocab_size=256, d_model=64, n_layers=2,
