@@ -2,6 +2,20 @@
 (BASELINE.json configs): MNIST CNN, ResNet, BERT, and the Llama
 decoder with LoRA — all flax, all written for bf16 MXU math and GSPMD
 sharding via :mod:`sparkdl_tpu.parallel.sharding`.
+
+Serving-side modules (imported by path, not re-exported — they pull
+decode-only machinery):
+
+- :mod:`.generate` — cached single-stream decode (+ top-k/top-p,
+  logprobs)
+- :mod:`.serving` — ContinuousBatchingEngine / SpeculativeBatchingEngine
+  (paged cache, prefix caching, multi-LoRA, stops, logprobs)
+- :mod:`.server` — HTTP front-end over any engine
+- :mod:`.speculative` — single-burst speculative decode + the
+  rejection-sampling core
+- :mod:`.quant` — int8/int4 weight-only serving conversions
+- :mod:`.convert` — HuggingFace Llama checkpoint import/export
+- :mod:`.moe` — expert-parallel MoE (psum-combine and a2a dispatch)
 """
 
 from sparkdl_tpu.models.bert import (  # noqa: F401
