@@ -10,7 +10,52 @@ driver prints it to stdout; outside any job (e.g. local ``np=-1`` mode,
 where driver == worker) it is printed directly.
 """
 
+import collections
+import os as _os
+
 MAX_LOG_MESSAGE_LENGTH = 4000  # reference sparkdl/horovod/__init__.py:23
+
+
+RestartContext = collections.namedtuple(
+    "RestartContext", ["attempt", "resume_step"]
+)
+RestartContext.__doc__ = """The gang supervisor's restart context.
+
+``attempt``: how many times this gang has been relaunched (0 on the
+first launch — unmodified mains can ignore the context entirely).
+``resume_step``: the latest :class:`~sparkdl_tpu.utils.checkpoint.
+TrainCheckpointer` step committed under
+``SPARKDL_TPU_GANG_RESUME_DIR`` when this attempt launched, or None
+when no checkpoint exists (start from scratch). See
+``docs/fault_tolerance.rst`` for the resume contract."""
+
+
+def restart_context():
+    """The supervisor's restart context for this worker process.
+
+    Checkpoint-aware training mains resume where the previous attempt
+    left off::
+
+        ctx = restart_context()
+        start = 0
+        if ctx.resume_step is not None:
+            state = ckpt.restore(ctx.resume_step, target=state)
+            start = ctx.resume_step + 1
+        for step in range(start, total_steps):
+            ...
+
+    Outside a supervised relaunch (first attempt, plain gangs, local
+    ``np=-1`` mode) this returns ``RestartContext(0, None)``, so
+    calling it unconditionally is always safe.
+    """
+    from sparkdl_tpu.horovod.supervisor import (
+        RESTART_ATTEMPT_ENV,
+        RESUME_STEP_ENV,
+    )
+
+    attempt = int(_os.environ.get(RESTART_ATTEMPT_ENV, "0"))
+    step = _os.environ.get(RESUME_STEP_ENV)
+    return RestartContext(attempt, int(step) if step is not None else None)
 
 
 def log_to_driver(message):
@@ -33,4 +78,4 @@ def log_to_driver(message):
         print(message, flush=True)
 
 
-__all__ = ["log_to_driver"]
+__all__ = ["log_to_driver", "restart_context", "RestartContext"]

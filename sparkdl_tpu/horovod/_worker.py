@@ -170,7 +170,13 @@ def main():
         ) as client:
             # 3. Gang rendezvous: jax.distributed.initialize against
             # the launcher's coordinator (replaces MPI rendezvous,
-            # BASELINE.json).
+            # BASELINE.json). The chaos hook sits in front of it so a
+            # fault-injection schedule can stall or kill this rank
+            # before it joins — inert without SPARKDL_TPU_CHAOS_* env.
+            from sparkdl_tpu.utils.chaos import on_worker_boot
+
+            on_worker_boot(rank)
+
             import sparkdl_tpu.hvd as hvd
 
             hvd.init()

@@ -45,6 +45,14 @@ MSG_RESULT_END = 9    # terminates a chunked RESULT
 
 _HEADER = struct.Struct(">IBI")  # length (of type+rank+payload), type, rank
 
+# Frame names for the chaos harness's drop/delay selectors
+# (SPARKDL_TPU_CHAOS_CP_DROP names frames by these strings).
+_MSG_NAMES = {
+    MSG_READY: "READY", MSG_LOG: "LOG", MSG_USERLOG: "USERLOG",
+    MSG_RESULT: "RESULT", MSG_EXC: "EXC", MSG_BYE: "BYE",
+    MSG_AUTH: "AUTH", MSG_RESULT_PART: "RESULT", MSG_RESULT_END: "RESULT",
+}
+
 CONTROL_ADDR_ENV = "SPARKDL_TPU_CONTROL_ADDR"
 RANK_ENV = "SPARKDL_TPU_RANK"
 CONTROL_SECRET_ENV = "SPARKDL_TPU_CONTROL_SECRET"
@@ -450,6 +458,19 @@ class ControlPlaneClient:
                 self._native = None
 
     def _send(self, mtype, payload):
+        # Fault-injection hook (inert without SPARKDL_TPU_CHAOS_* env):
+        # the chaos harness can delay or drop control frames to
+        # simulate a flaky control plane — a dropped READY stalls the
+        # gang barrier, a dropped RESULT exercises the lost-result
+        # path. The native log ring is not hooked (logs are droppable
+        # by design).
+        from sparkdl_tpu.utils.chaos import control_frame_fate
+
+        fate = control_frame_fate(_MSG_NAMES.get(mtype, str(mtype)))
+        if fate == "drop":
+            return
+        if fate:
+            time.sleep(fate)
         frame = _HEADER.pack(len(payload) + 5, mtype, self.rank) + payload
         with self._lock:
             try:

@@ -425,10 +425,17 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     """Launch a gang of workers and return rank 0's result.
 
     Recovery model (SURVEY.md §5.3): gangs are fail-fast, not elastic —
-    the recovery story is relaunch. Set ``SPARKDL_TPU_MAX_RESTARTS=N``
-    to retry a failed gang up to N times (fresh job dir, fresh
-    rendezvous) before surfacing the error; slot-exhaustion failures
-    are never retried (they cannot self-heal).
+    the recovery story is supervised relaunch
+    (:mod:`sparkdl_tpu.horovod.supervisor`). Set
+    ``SPARKDL_TPU_GANG_MAX_RETRIES=N`` (legacy alias
+    ``SPARKDL_TPU_MAX_RESTARTS``) to relaunch a gang whose failure
+    classifies as *transient* — preemption-style signal deaths,
+    rendezvous timeouts, control-plane resets — up to N times under
+    exponential backoff (fresh job dir, fresh rendezvous), shipping a
+    restart context (attempt number + latest checkpoint step from
+    ``SPARKDL_TPU_GANG_RESUME_DIR``) to the relaunched workers.
+    *Permanent* failures — user-code exceptions, slot exhaustion, bad
+    arguments — are never retried.
 
     :param per_rank_kwargs: optional list (len = gang size) of dicts
         merged into ``kwargs`` for each rank and serialized into that
@@ -436,32 +443,23 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
         shard) is shipped only to its worker instead of to the whole
         gang.
     """
-    max_restarts = int(os.environ.get("SPARKDL_TPU_MAX_RESTARTS", "0"))
-    attempt = 0
-    while True:
-        try:
-            return _launch_gang_once(
-                np, main, kwargs, driver_log_verbosity, per_rank_kwargs
-            )
-        except (SlotExhaustionError, SlotProbeError, SlotWaitTimeout,
-                RemoteTransportError):
-            raise  # typed, never retryable (cannot self-heal)
-        except RuntimeError as e:
-            if attempt >= max_restarts:
-                raise
-            attempt += 1
-            first_line = (str(e).splitlines() or ["<no message>"])[0]
-            logger.warning(
-                "HorovodRunner gang failed (attempt %d/%d); relaunching: %s",
-                attempt, max_restarts, first_line,
-            )
+    from sparkdl_tpu.horovod.supervisor import RetryPolicy, supervise
+
+    return supervise(
+        lambda extra_env: _launch_gang_once(
+            np, main, kwargs, driver_log_verbosity, per_rank_kwargs,
+            extra_env=extra_env,
+        ),
+        RetryPolicy.from_env(),
+    )
 
 
 def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
-                      per_rank_kwargs=None):
+                      per_rank_kwargs=None, extra_env=None):
     import cloudpickle
 
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
+    from sparkdl_tpu.horovod.supervisor import GangFailure
     from sparkdl_tpu.horovod.topology import Placement, is_local_host
 
     if np == 0:
@@ -664,6 +662,11 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 payload_path=payload_paths[r], job_dir=job_dir,
                 platform=platform, placement=gang_placement,
             )
+            if extra_env:
+                # Supervisor restart context (attempt number, resume
+                # step) — merged per worker, never into the driver's
+                # own os.environ.
+                env.update(extra_env)
             # Boot-phase output (before the worker installs its log tee
             # — e.g. import errors) lands in the same per-rank log file
             # via an O_APPEND handle, so nothing is ever lost.
@@ -723,7 +726,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 pass
         boot_paths.clear()
 
-        def _fail(reason, exit_codes=None):
+        def _fail(reason, exit_codes=None, kind="unknown"):
             excs = server.exceptions
             detail = "\n".join(
                 f"--- rank {r} ---\n{tb}" for r, tb in sorted(excs.items())
@@ -739,7 +742,14 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                     + _tail(os.path.join(job_dir, f"rank-{r}.log"))
                     for r in bad
                 )
-            raise RuntimeError(f"{reason}\n{detail}")
+            # GangFailure (a RuntimeError) carries the evidence the
+            # supervisor's transient-vs-permanent classifier reads:
+            # per-rank exit codes (negative = signal = what preemption
+            # looks like) and EXC tracebacks.
+            raise GangFailure(
+                f"{reason}\n{detail}", kind=kind,
+                exit_codes=list(exit_codes or []), exceptions=excs,
+            )
 
         # Gang rendezvous with fail-fast (reference runner_base.py:54-58):
         # abort immediately if any worker dies before READY, not after
@@ -756,14 +766,16 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 _fail(
                     "HorovodRunner gang failed to start: worker(s) "
                     f"{[r for r, _ in dead]} exited during rendezvous "
-                    f"(codes {[c for _, c in dead]}). Worker logs: {job_dir}"
+                    f"(codes {[c for _, c in dead]}). Worker logs: {job_dir}",
+                    [p.poll() or 0 for p in procs], kind="start_failure",
                 )
             if time.monotonic() > deadline:
                 _fail(
                     f"HorovodRunner gang failed to start: only "
                     f"{server.ready_count()}/{num_workers} workers reached "
                     f"the rendezvous within {timeout:.0f}s (fail-fast, "
-                    f"reference runner_base.py:54-58). Worker logs: {job_dir}"
+                    f"reference runner_base.py:54-58). Worker logs: {job_dir}",
+                    kind="rendezvous_timeout",
                 )
             time.sleep(0.05)
 
@@ -788,13 +800,14 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                         f"died; surviving ranks were killed after a "
                         f"{grace:.0f}s grace period to avoid a wedged "
                         f"collective.", [c or 0 for c in codes],
+                        kind="worker_death",
                     )
             time.sleep(0.1)
         exit_codes = [p.wait() for p in procs]
         if any(exit_codes):
             _fail(
                 f"HorovodRunner job failed (exit codes {exit_codes}).",
-                exit_codes,
+                exit_codes, kind="worker_death",
             )
 
         # Drain the control plane: all workers have exited, so their
@@ -809,9 +822,13 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             if result_bytes is None:
                 time.sleep(0.05)
         if result_bytes is None:
-            raise RuntimeError(
+            # Workers all exited 0 but the RESULT frame never arrived:
+            # a control-plane delivery failure, classified transient
+            # (a relaunch re-runs the job and re-ships the result).
+            raise GangFailure(
                 "HorovodRunner job finished but rank 0 returned no result "
-                f"over the control plane. Worker logs: {job_dir}"
+                f"over the control plane. Worker logs: {job_dir}",
+                kind="no_result",
             )
         return cloudpickle.loads(result_bytes)
     finally:

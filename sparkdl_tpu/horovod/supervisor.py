@@ -1,0 +1,357 @@
+"""Gang supervision: preemption-aware retry with checkpoint resume.
+
+The launcher's gangs are fail-fast by design (reference
+``runner_base.py:54-58``): any rank dying kills the whole job. Real
+TPU pods, however, get preempted, lose hosts, and hit transient
+rendezvous failures — and for those, throwing the run away is the
+wrong answer when :class:`~sparkdl_tpu.utils.checkpoint.
+TrainCheckpointer` already persists every step. This module wraps
+``_launch_gang_once`` with the production recovery loop (the spirit of
+Horovod's elastic mode, Sergeev & Del Balso 2018, restricted to
+gang-relaunch granularity — one jax world per attempt, no membership
+changes mid-run):
+
+1. **Classify** each failure as *transient* (worker SIGKILL/
+   preemption, rendezvous timeout, control-plane connection reset,
+   port clash) or *permanent* (user-code exception, slot exhaustion,
+   bad arguments). Permanent failures surface immediately — more
+   restarts cannot create slots or fix user code.
+2. **Relaunch** transient failures under exponential backoff with
+   jitter (thundering-herd safety when many drivers share a
+   control plane), up to a retry budget.
+3. **Resume**: each relaunch ships a restart context to the workers —
+   attempt number and, when a checkpoint directory is configured, the
+   latest committed :class:`TrainCheckpointer` step — via env vars
+   read by :func:`sparkdl_tpu.horovod.restart_context`. Unmodified
+   mains keep working (the context is additive); checkpoint-aware
+   mains restart where they left off.
+4. **Exhaust loudly**: when the budget runs out,
+   :class:`GangRetryBudgetExhausted` names every attempt with its
+   classified cause — nothing is swallowed.
+
+Knobs (all env-driven so ``HorovodRunner.run``'s locked signature is
+untouched; see ``docs/fault_tolerance.rst``):
+
+- ``SPARKDL_TPU_GANG_MAX_RETRIES``: relaunch budget for transient
+  failures (default 0 — supervision off; ``SPARKDL_TPU_MAX_RESTARTS``
+  is honored as a legacy alias).
+- ``SPARKDL_TPU_GANG_BACKOFF_BASE`` / ``..._FACTOR`` / ``..._MAX``:
+  exponential backoff schedule in seconds (defaults 1 / 2 / 60).
+- ``SPARKDL_TPU_GANG_BACKOFF_JITTER``: jitter fraction added on top
+  of each delay (default 0.5 — up to +50%).
+- ``SPARKDL_TPU_GANG_RESUME_DIR``: TrainCheckpointer root whose
+  latest committed step is shipped as the resume point.
+- ``SPARKDL_TPU_TRANSIENT_PATTERNS``: ``;``-separated extra
+  signatures (case-insensitive substring match against worker
+  tracebacks) an operator can add for an interconnect whose
+  infrastructure errors this module does not know yet.
+"""
+
+import dataclasses
+import logging
+import os
+import random
+import re
+import time
+
+logger = logging.getLogger("HorovodRunner")
+
+GANG_MAX_RETRIES_ENV = "SPARKDL_TPU_GANG_MAX_RETRIES"
+LEGACY_MAX_RESTARTS_ENV = "SPARKDL_TPU_MAX_RESTARTS"
+BACKOFF_BASE_ENV = "SPARKDL_TPU_GANG_BACKOFF_BASE"
+BACKOFF_FACTOR_ENV = "SPARKDL_TPU_GANG_BACKOFF_FACTOR"
+BACKOFF_MAX_ENV = "SPARKDL_TPU_GANG_BACKOFF_MAX"
+BACKOFF_JITTER_ENV = "SPARKDL_TPU_GANG_BACKOFF_JITTER"
+RESUME_DIR_ENV = "SPARKDL_TPU_GANG_RESUME_DIR"
+EXTRA_PATTERNS_ENV = "SPARKDL_TPU_TRANSIENT_PATTERNS"
+
+# The restart context workers read back via
+# sparkdl_tpu.horovod.restart_context(). Shipped per-attempt through
+# the worker env (never mutated in the driver's own os.environ — two
+# concurrent supervised gangs in one driver must not see each other's
+# attempt counters).
+RESTART_ATTEMPT_ENV = "SPARKDL_TPU_RESTART_ATTEMPT"
+RESUME_STEP_ENV = "SPARKDL_TPU_RESUME_STEP"
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+# Infrastructure signatures in worker tracebacks (case-insensitive
+# substring match). An EXC frame matching one of these is the gang
+# runtime failing, not the user's main: the rank observing a peer's
+# preemption raises a connection/collective error of its own, and
+# classifying that as "user code" would veto the retry the preempted
+# gang exists to get. Extend via SPARKDL_TPU_TRANSIENT_PATTERNS.
+TRANSIENT_SIGNATURES = (
+    "connection reset",
+    "connection closed",
+    "connection refused",
+    "connection aborted",
+    "broken pipe",
+    "socket closed",
+    "address already in use",       # coordinator/control-plane port clash
+    "deadline_exceeded",
+    "deadline exceeded",
+    "unavailable:",                 # grpc status prefix
+    "failed to connect",
+    "coordination service",         # jax.distributed heartbeats
+    "heartbeat",
+    "barrier timed out",
+    "rendezvous",
+    "gloo",                         # CPU-rig collective runtime
+    "preempt",
+)
+
+
+class GangFailure(RuntimeError):
+    """A launched gang failed. Carries the structured evidence the
+    supervisor classifies on: ``kind`` (``"rendezvous_timeout"``,
+    ``"worker_death"``, ``"start_failure"``, ``"no_result"``),
+    per-rank ``exit_codes`` (negative = killed by that signal), and
+    ``exceptions`` (rank → traceback text from EXC frames). Subclasses
+    RuntimeError so pre-supervisor callers keep working."""
+
+    def __init__(self, message, *, kind="unknown", exit_codes=None,
+                 exceptions=None):
+        super().__init__(message)
+        self.kind = kind
+        self.exit_codes = list(exit_codes or [])
+        self.exceptions = dict(exceptions or {})
+
+
+@dataclasses.dataclass
+class AttemptRecord:
+    """One launch attempt, as named in the exhaustion error."""
+    number: int
+    verdict: str       # TRANSIENT | PERMANENT
+    cause: str
+
+    def __str__(self):
+        return f"attempt {self.number}: {self.verdict} — {self.cause}"
+
+
+class GangRetryBudgetExhausted(RuntimeError):
+    """Every relaunch in the budget failed transiently. The message
+    names every attempt with its classified cause — the loud final
+    word the acceptance contract requires."""
+
+    def __init__(self, attempts, budget):
+        self.attempts = list(attempts)
+        self.budget = budget
+        lines = "\n".join(f"  {a}" for a in self.attempts)
+        super().__init__(
+            f"HorovodRunner gang failed {len(self.attempts)} time(s); "
+            f"retry budget ({budget} relaunch(es)) exhausted. "
+            f"Attempt log:\n{lines}"
+        )
+
+
+def _extra_patterns():
+    raw = os.environ.get(EXTRA_PATTERNS_ENV, "")
+    return tuple(p.strip().lower() for p in raw.split(";") if p.strip())
+
+
+def _terminal_block(tb_text):
+    """The traceback's final exception message: from the last
+    unindented non-header line (``SomeError: message``) to the end, so
+    multi-line messages are kept. Frame lines (``File "/u/gloo.py"``)
+    and source echoes are excluded — a user file PATH or source line
+    mentioning 'gloo'/'rendezvous' must never read as infrastructure."""
+    lines = tb_text.rstrip().splitlines()
+    start = 0
+    for i, ln in enumerate(lines):
+        if (ln and not ln[0].isspace()
+                and not ln.startswith("Traceback (")
+                and not ln.startswith("During handling")
+                and not ln.startswith("The above exception")):
+            start = i
+    return "\n".join(lines[start:])
+
+
+def _is_infra_traceback(tb_text):
+    """True when a worker's EXC frame is the distributed runtime
+    failing (connection/collective/rendezvous errors), not user code.
+    Checked against the TERMINAL exception block only — type line plus
+    its message — never against file paths or source lines, so user
+    code that merely lives near infrastructure vocabulary stays
+    classified as user code (and is never retried)."""
+    if not tb_text.strip():
+        return False
+    term = _terminal_block(tb_text)
+    if re.match(
+        r"(\w+\.)*(Connection(Reset|Refused|Aborted)?Error|"
+        r"BrokenPipeError|TimeoutError|socket\.timeout)\b",
+        term,
+    ):
+        return True
+    low = term.lower()
+    return any(
+        sig in low for sig in TRANSIENT_SIGNATURES + _extra_patterns()
+    )
+
+
+def classify_failure(exc):
+    """(verdict, cause): *permanent* failures are never retried.
+
+    Taxonomy (ISSUE: preemption-aware supervision):
+
+    - Typed launcher errors (slot exhaustion/probe/wait, remote
+      transport) and bad arguments → permanent; the launcher already
+      documents why each cannot self-heal.
+    - A worker EXC frame that is NOT an infrastructure error →
+      permanent: user code raised, and rerunning user bugs burns pod
+      hours to reproduce them.
+    - Rendezvous timeouts, lost results, ranks killed by signals
+      (SIGKILL is what preemption looks like from the driver), and
+      infrastructure-only EXC frames → transient.
+    - Anything else (e.g. a worker exiting 1 with no traceback — a
+      bootstrap crash such as an import error) → permanent: retrying
+      what we cannot name would hide real breakage.
+    """
+    # Local import: launcher imports this module at call time too, and
+    # a module-level circular import would order-lock the two.
+    from sparkdl_tpu.horovod.launcher import (
+        RemoteTransportError,
+        SlotExhaustionError,
+        SlotProbeError,
+        SlotWaitTimeout,
+    )
+
+    if isinstance(exc, (SlotExhaustionError, SlotProbeError,
+                        SlotWaitTimeout, RemoteTransportError)):
+        return PERMANENT, f"{type(exc).__name__} (cannot self-heal)"
+    if isinstance(exc, (ValueError, TypeError)):
+        return PERMANENT, f"bad arguments ({type(exc).__name__})"
+    if isinstance(exc, GangFailure):
+        user_ranks = [
+            r for r, tb in sorted(exc.exceptions.items())
+            if not _is_infra_traceback(tb)
+        ]
+        if user_ranks:
+            return PERMANENT, (
+                f"user-code exception on rank(s) {user_ranks}"
+            )
+        if exc.kind == "rendezvous_timeout":
+            return TRANSIENT, "gang rendezvous timed out"
+        if exc.kind == "no_result":
+            return TRANSIENT, "rank 0 result lost on the control plane"
+        killed = [
+            (r, -c) for r, c in enumerate(exc.exit_codes) if c and c < 0
+        ]
+        if killed:
+            return TRANSIENT, (
+                "rank(s) killed by signal "
+                + ", ".join(f"{r} (sig {s})" for r, s in killed)
+                + " — preemption-like"
+            )
+        if exc.exceptions:  # all infra tracebacks, no signal deaths
+            return TRANSIENT, (
+                "infrastructure failure on rank(s) "
+                f"{sorted(exc.exceptions)}"
+            )
+        return PERMANENT, (
+            f"unclassified worker failure (kind={exc.kind}, exit codes "
+            f"{exc.exit_codes}) — not retried blindly"
+        )
+    return PERMANENT, f"unclassified {type(exc).__name__} (not retried)"
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Relaunch budget + backoff schedule + resume source."""
+    max_retries: int = 0
+    backoff_base: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max: float = 60.0
+    jitter: float = 0.5
+    resume_dir: str = None
+
+    @classmethod
+    def from_env(cls, env=None):
+        env = os.environ if env is None else env
+        retries = env.get(GANG_MAX_RETRIES_ENV)
+        if retries is None:
+            # Legacy knob: same budget, but under the new policy only
+            # TRANSIENT failures consume it (retrying a user exception
+            # was always a bug amplifier).
+            retries = env.get(LEGACY_MAX_RESTARTS_ENV, "0")
+        return cls(
+            max_retries=int(retries),
+            backoff_base=float(env.get(BACKOFF_BASE_ENV, "1.0")),
+            backoff_factor=float(env.get(BACKOFF_FACTOR_ENV, "2.0")),
+            backoff_max=float(env.get(BACKOFF_MAX_ENV, "60.0")),
+            jitter=float(env.get(BACKOFF_JITTER_ENV, "0.5")),
+            resume_dir=env.get(RESUME_DIR_ENV) or None,
+        )
+
+    def backoff(self, attempt, _random=random.random):
+        """Delay before relaunch #``attempt`` (1-based): capped
+        exponential plus up to ``jitter`` fraction on top."""
+        base = min(
+            self.backoff_base * self.backoff_factor ** (attempt - 1),
+            self.backoff_max,
+        )
+        return base * (1.0 + self.jitter * _random())
+
+
+def _resume_step(policy):
+    if not policy.resume_dir:
+        return None
+    from sparkdl_tpu.utils.checkpoint import latest_complete_step
+
+    return latest_complete_step(policy.resume_dir)
+
+
+def supervise(launch, policy, _sleep=time.sleep):
+    """Run ``launch(extra_env)`` under the retry policy.
+
+    ``launch`` is called with the env delta to merge into every
+    worker's environment (the restart context); it must raise on
+    failure and return the gang result on success. The first attempt
+    ships no context (unmodified mains see attempt 0 / no resume
+    step); each relaunch ships the incremented attempt number and the
+    newest committed checkpoint step.
+    """
+    attempts = []
+    attempt = 1
+    while True:
+        extra_env = {}
+        if attempt > 1:
+            extra_env[RESTART_ATTEMPT_ENV] = str(attempt - 1)
+            step = _resume_step(policy)
+            if step is not None:
+                extra_env[RESUME_STEP_ENV] = str(step)
+        try:
+            return launch(extra_env)
+        except Exception as e:
+            verdict, cause = classify_failure(e)
+            attempts.append(AttemptRecord(attempt, verdict, cause))
+            first_line = (str(e).splitlines() or ["<no message>"])[0]
+            if verdict == PERMANENT:
+                logger.error(
+                    "HorovodRunner gang failed permanently on attempt "
+                    "%d (%s); not retrying: %s",
+                    attempt, cause, first_line,
+                )
+                raise
+            if attempt > policy.max_retries:
+                if policy.max_retries > 0:
+                    raise GangRetryBudgetExhausted(
+                        attempts, policy.max_retries
+                    ) from e
+                raise  # supervision off: surface the failure untouched
+            delay = policy.backoff(attempt)
+            # Recomputed at the top of the next iteration too (listdir
+            # is cheap); shown here so the operator sees the resume
+            # point BEFORE the backoff sleep, not after.
+            resume = _resume_step(policy)
+            logger.warning(
+                "HorovodRunner gang failed transiently (attempt %d/%d: "
+                "%s); relaunching in %.1fs%s: %s",
+                attempt, policy.max_retries + 1, cause, delay,
+                "" if resume is None
+                else f" (will resume from step {resume})",
+                first_line,
+            )
+            _sleep(delay)
+            attempt += 1

@@ -184,34 +184,54 @@ def broadcast(tensor, root_rank, name=None):
     return from_numpy_like(out, tensor)
 
 
+# Payload-size limb codec for the object collectives. Sizes must ride
+# a collective themselves, and every scalar carrier loses on some rig:
+# float64 canonicalizes to float32 with x64 off (exact only to 2**24 —
+# a ~16.7 MB pickle already decodes to the wrong byte count, silently,
+# anywhere in the 2**24..2**31 window), and int64 canonicalizes to
+# int32 (a >2 GiB size wraps negative). Two int32 limbs via
+# divmod 2**20 survive canonicalization untouched and are exact to
+# 2**51 bytes; the loud >= 2 GiB guard below still bounds the actual
+# payload collective.
+_SIZE_LIMB = 1 << 20
+
+
+def _size_to_limbs(n):
+    hi, lo = divmod(int(n), _SIZE_LIMB)
+    return np.array([hi, lo], np.int32)
+
+
+def _size_from_limbs(limbs):
+    return int(limbs[0]) * _SIZE_LIMB + int(limbs[1])
+
+
 def broadcast_object(obj, root_rank=0, name=None):
     """Pickle-based object broadcast (horovod.broadcast_object parity):
-    length is broadcast first, then the payload as a uint8 tensor."""
+    length is broadcast first (as two int32 limbs — see the codec
+    note above), then the payload as a uint8 tensor."""
     del name
     _state.require_initialized()
     if size() == 1:
         return obj
     if rank() == root_rank:
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-        n = np.array([payload.shape[0]], np.float64)
+        limbs = _size_to_limbs(payload.shape[0])
     else:
         payload = None
-        n = np.zeros((1,), np.float64)
-    # Size rides float64 (exact to 2**53): the collective engine
-    # canonicalizes ints to int32 when x64 is off, which would wrap a
-    # >2 GiB size negative. The payload broadcast itself is still
-    # int32-bounded, so oversize fails loudly — AFTER the exchange, so
-    # every rank raises together instead of the big rank bailing
-    # pre-collective and wedging the others mid-broadcast.
-    n = engine().broadcast(n, root_rank)
-    if int(n[0]) >= 2**31:
+        limbs = np.zeros((2,), np.int32)
+    # The payload broadcast is int32-bounded, so oversize fails
+    # loudly — AFTER the size exchange, so every rank raises together
+    # instead of the big rank bailing pre-collective and wedging the
+    # others mid-broadcast.
+    n = _size_from_limbs(engine().broadcast(limbs, root_rank))
+    if n >= 2**31:
         raise ValueError(
-            f"broadcast_object payload is {int(n[0])} bytes; the "
+            f"broadcast_object payload is {n} bytes; the "
             "payload broadcast is int32-bounded (< 2 GiB pickled). "
             "Broadcast a reference (path/handle) instead."
         )
     if payload is None:
-        payload = np.zeros((int(n[0]),), np.uint8)
+        payload = np.zeros((n,), np.uint8)
     payload = engine().broadcast(payload, root_rank)
     return pickle.loads(payload.tobytes())
 
@@ -225,25 +245,28 @@ def allgather_object(obj, name=None):
     if size() == 1:
         return [obj]
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-    # Sizes ride float64 (exact to 2**53; int32 canonicalization would
-    # wrap >2 GiB negative and corrupt every unpack offset). The guard
-    # fires AFTER the size exchange so every rank raises the same
-    # error together — a lone oversized rank bailing pre-collective
-    # would leave the rest of the gang wedged in the allgather.
-    sizes = engine().allgather(
-        np.array([[payload.shape[0]]], np.float64))
-    if sizes.max() >= 2**31:
+    # Sizes ride the int32 limb codec (see note above broadcast_object:
+    # float64 silently rounds to float32 precision with x64 off,
+    # corrupting every unpack offset for >16.7 MB payloads; int64
+    # wraps). The guard fires AFTER the size exchange so every rank
+    # raises the same error together — a lone oversized rank bailing
+    # pre-collective would leave the rest of the gang wedged in the
+    # allgather.
+    limb_rows = engine().allgather(
+        _size_to_limbs(payload.shape[0])[None, :])
+    counts = [_size_from_limbs(row) for row in limb_rows]
+    if max(counts) >= 2**31:
         raise ValueError(
-            f"allgather_object payload of {int(sizes.max())} bytes on "
-            f"rank {int(sizes[:, 0].argmax())}: the payload gather is "
+            f"allgather_object payload of {max(counts)} bytes on "
+            f"rank {counts.index(max(counts))}: the payload gather is "
             "int32-bounded (< 2 GiB pickled). Gather a reference "
             "(path/handle) instead of the object."
         )
     flat = engine().allgather(payload)
     out, off = [], 0
-    for n in sizes[:, 0]:
-        out.append(pickle.loads(flat[off:off + int(n)].tobytes()))
-        off += int(n)
+    for n in counts:
+        out.append(pickle.loads(flat[off:off + n].tobytes()))
+        off += n
     return out
 
 

@@ -27,6 +27,14 @@ MIN = "min"
 MAX = "max"
 
 
+# The engine must not die on an older jax — a gang that cannot build
+# its collectives takes every fault-tolerance guarantee down with it.
+from sparkdl_tpu.utils.jax_compat import (
+    axis_size as _axis_size,
+    shard_map as _shard_map,
+)
+
+
 def _is_float_dtype(dtype):
     """numpy floats plus ml_dtypes extensions (bfloat16 etc.), which
     np.issubdtype does not recognize as np.floating."""
@@ -92,7 +100,7 @@ class _CollectiveEngine:
             # would allocate + traverse the full tensor again per call
             # (measured ~2x end-to-end allreduce time at 64 MB).
             body = lambda x: (
-                jax.lax.psum(x[0], "hvd") / jax.lax.axis_size("hvd")
+                jax.lax.psum(x[0], "hvd") / _axis_size("hvd")
             )
         elif kind == "min":
             body = lambda x: jax.lax.pmin(x[0], "hvd")
@@ -110,7 +118,7 @@ class _CollectiveEngine:
                     x[0], "hvd", scatter_dimension=0, tiled=True
                 )
                 if kind == "scatter_avg":
-                    out = out / jax.lax.axis_size("hvd")
+                    out = out / _axis_size("hvd")
                 return out
         elif kind[0] == "bcast":
             # True broadcast: binary-tree ppermute — the set of ranks
@@ -147,7 +155,7 @@ class _CollectiveEngine:
             # rank j in one collective (XLA all-to-all over ICI).
             def body(x):
                 blk = x[0]  # (n*chunk, ...)
-                n = jax.lax.axis_size("hvd")
+                n = _axis_size("hvd")
                 parts = blk.reshape((n, blk.shape[0] // n) + blk.shape[1:])
                 out = jax.lax.all_to_all(
                     parts, "hvd", split_axis=0, concat_axis=0, tiled=False
@@ -162,18 +170,18 @@ class _CollectiveEngine:
         # disable for those.
         partitioned = kind in ("alltoall", "scatter_sum", "scatter_avg")
         out_spec = P("hvd") if partitioned else P()
-        extra = (
-            {"check_vma": False}
+        check_vma = (
+            False
             if partitioned or kind == "gather" or kind[0] == "bcast"
-            else {}
+            else None
         )
         with self._lock:
             fn = self._fns.get(key)
             if fn is None:
                 fn = jax.jit(
-                    jax.shard_map(
+                    _shard_map(
                         body, mesh=mesh, in_specs=P("hvd"),
-                        out_specs=out_spec, **extra,
+                        out_specs=out_spec, check_vma=check_vma,
                     ),
                     out_shardings=NamedSharding(mesh, out_spec),
                 )

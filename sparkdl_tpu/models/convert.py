@@ -77,8 +77,13 @@ def params_from_hf(state_dict, cfg, dtype=None):
     """Map an HF Llama state dict onto the flax tree ``Llama(cfg)``
     expects. ``state_dict``: ``model.state_dict()`` from a
     ``LlamaForCausalLM`` (keys ``model.embed_tokens.weight``, ...).
-    ``dtype``: cast 2-D kernels (default: keep fp32; pass
-    ``jnp.bfloat16`` for serving trees).
+    ``dtype``: cast weights (default: keep fp32; pass ``jnp.bfloat16``
+    for serving trees). Applies to EVERY kernel including the lm_head
+    in both its branches — a real ``lm_head.weight`` and the
+    tied-embedding fallback — so a bf16 serving tree is bf16 end to
+    end (an fp32 lm_head would silently dominate the tree's memory:
+    vocab × d_model is the single largest matrix). Norm scales stay
+    fp32: they are tiny and RMSNorm accumulates in fp32 anyway.
 
     Strict: every weight in the state dict must be consumed by the
     mapping (modulo known harmless buffers) — an attention-bias or
@@ -100,11 +105,11 @@ def params_from_hf(state_dict, cfg, dtype=None):
     consumed.update(("model.embed_tokens.weight", "model.norm.weight"))
     if "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": jnp.asarray(
-            sd["lm_head.weight"].T, jnp.float32)}
+            sd["lm_head.weight"].T, dtype or jnp.float32)}
         consumed.add("lm_head.weight")
     else:  # tie_word_embeddings
         params["lm_head"] = {"kernel": jnp.asarray(
-            sd["model.embed_tokens.weight"].T, jnp.float32)}
+            sd["model.embed_tokens.weight"].T, dtype or jnp.float32)}
     for i in range(cfg.n_layers):
         hf = f"model.layers.{i}"
         params[f"layer_{i}"] = {

@@ -25,6 +25,14 @@ API::
       event per generated token, then ``data: {"done": ...}``.
     GET /health -> {"status": "ok", "queued": N}
 
+Error classification (clients and load balancers must be able to
+tell bad input from a sick server): request-validation failures are
+**400**; an engine ``run()`` fault on admitted requests is **500**;
+shutdown (or a dead engine loop) fails outstanding waiters with
+**503** — retry against another replica. The SSE path has already
+committed 200 by the time the engine can fault, so stream errors ride
+a terminal ``data: {"error": ...}`` event instead.
+
 No reference counterpart (the reference is a training-launcher stub);
 this completes the serving story: model -> engine -> service.
 """
@@ -33,6 +41,13 @@ import json
 import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _status_safe(message):
+    """One latin-1 line, bounded — the only shape ``send_error`` can
+    put on an HTTP status line without corrupting the response."""
+    message = " ".join(str(message).split())[:400]
+    return message.encode("latin-1", "replace").decode("latin-1")
 
 
 class _Mailbox:
@@ -44,6 +59,23 @@ class _Mailbox:
         self.done = threading.Event()
         self.result = None           # (tokens, finish_reason, logprobs)
         self.error = None
+        self.error_code = 500        # set by fail(); 500 = engine fault
+
+    def fail(self, code, message):
+        """Fail the waiter with an HTTP status that tells the client —
+        and any load balancer health-checking this box — WHOSE fault
+        it was: 400 the request's, 500 the engine's, 503 the server's
+        lifecycle (shutting down / loop dead, i.e. retry elsewhere).
+
+        The message rides the HTTP status line (``send_error``), which
+        is one latin-1 line by protocol: multi-line engine tracebacks
+        are collapsed and truncated here or they would split the
+        status line (and non-latin-1 text would crash the handler
+        instead of answering)."""
+        self.error_code = code
+        self.error = _status_safe(message)
+        self.tokens.put(None)
+        self.done.set()
 
 
 class ServingFrontend:
@@ -112,7 +144,7 @@ class ServingFrontend:
                             f"({frontend.engine.cfg.max_cache_len})")
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
-                    self.send_error(400, str(e))
+                    self.send_error(400, _status_safe(e))
                     return
                 box = _Mailbox()
                 frontend._arrivals.put((parsed, box))
@@ -125,7 +157,11 @@ class ServingFrontend:
 
             def _respond(self, box):
                 if box.error is not None:
-                    self.send_error(400, box.error)
+                    # 400 = the request's fault, 500 = the engine's,
+                    # 503 = lifecycle (see _Mailbox.fail) — clients
+                    # and load balancers must be able to tell bad
+                    # input from a sick server.
+                    self.send_error(box.error_code, box.error)
                     return
                 toks, reason, lps = box.result
                 body = json.dumps({
@@ -182,22 +218,22 @@ class ServingFrontend:
                 self._live[rid] = box
             except (ValueError, TypeError) as e:
                 # backstop: do_POST pre-validates, but engine-specific
-                # constraints (adapters, prefixes) can still refuse
-                box.error = str(e)
-                box.tokens.put(None)
-                box.done.set()
+                # constraints (adapters, prefixes) can still refuse —
+                # that refusal is about the REQUEST, hence 400
+                box.fail(400, str(e))
 
     def _engine_loop(self):
         try:
             self._serve_bursts()
         finally:
             # shutdown (or a loop crash) must not strand handler
-            # threads on untimed waits: fail every outstanding mailbox
+            # threads on untimed waits: fail every outstanding mailbox.
+            # 503, not 500: the server is going away (or its loop
+            # died), so the client should retry against another
+            # replica — a load balancer treats 503 as "drain me".
             self._poll_queue(self.engine)  # pull stragglers out of
             for box in self._live.values():    # _arrivals first
-                box.error = "server shutting down"
-                box.tokens.put(None)
-                box.done.set()
+                box.fail(503, "server shutting down")
             self._live.clear()
 
     def _serve_bursts(self):
@@ -217,9 +253,11 @@ class ServingFrontend:
                                           on_token=on_token)
             except Exception as e:  # engine fault: fail the waiters
                 for box in self._live.values():   # and keep serving
-                    box.error = f"engine error: {e}"
-                    box.tokens.put(None)
-                    box.done.set()
+                    # 500: the ENGINE broke mid-run on a request the
+                    # validator admitted — the client sent nothing
+                    # wrong, and a 400 here would teach callers to
+                    # "fix" requests that were never broken
+                    box.fail(500, f"engine error: {e}")
                 self._live.clear()
                 # the engine still holds the poison request (queued or
                 # mid-slot); without this a deterministic fault would

@@ -102,6 +102,8 @@ def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from sparkdl_tpu.utils.jax_compat import tpu_compiler_params
+
     b, h, d = q.shape
     n_pages, page, hkv, dk = k_pool.shape
     assert dk == d and h % hkv == 0, (q.shape, k_pool.shape)
@@ -136,7 +138,7 @@ def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -164,7 +166,9 @@ def paged_attention_decode_sharded(mesh, *, axis_name="model",
             interpret=interpret,
         )
 
-    return jax.shard_map(
+    from sparkdl_tpu.utils.jax_compat import shard_map
+
+    return shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(None, axis_name, None),
                   P(None, None, axis_name, None),

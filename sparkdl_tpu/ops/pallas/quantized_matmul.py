@@ -43,7 +43,7 @@ def quantized_matmul_pallas(x, w_q, scales, *, block_m=128, block_n=128,
                             interpret=False):
     """x (M, K) @ dequant(w_q (K, N)) with per-column scales (N,)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from sparkdl_tpu.utils.jax_compat import tpu_compiler_params
 
     m, k = x.shape
     _, n = w_q.shape
@@ -62,7 +62,7 @@ def quantized_matmul_pallas(x, w_q, scales, *, block_m=128, block_n=128,
             pl.BlockSpec((bn,), lambda i, j: (j,)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel"),
         ),
         interpret=interpret,
@@ -225,7 +225,7 @@ def quantized_matmul_int4_pallas(x, packed, scales, *, group=INT4_GROUP,
                                  interpret=False):
     """x (M, K) @ dequant(packed (K//2, N)) with (K//group, N) scales."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from sparkdl_tpu.utils.jax_compat import tpu_compiler_params
 
     m, k = x.shape
     kh, n = packed.shape
@@ -245,7 +245,7 @@ def quantized_matmul_int4_pallas(x, packed, scales, *, group=INT4_GROUP,
             pl.BlockSpec((k // group, bn), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel"),
         ),
         interpret=interpret,

@@ -17,6 +17,8 @@ no pipeline parallelism at all, SURVEY.md §2.3).
 
 import jax
 import jax.numpy as jnp
+
+from sparkdl_tpu.utils.jax_compat import axis_size
 from jax.sharding import PartitionSpec as P
 
 
@@ -33,7 +35,7 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, *,
     :return: (M, mb, ...) outputs, replicated (psum-collected from the
         last stage).
     """
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
     params_local = jax.tree.map(lambda x: x[0], stacked_params)
@@ -98,7 +100,9 @@ def make_pipeline(mesh, stage_fn, *, axis_name="stage"):
             jax.tree.map(spec_for, stacked_params),
             P(),
         )
-        fn = jax.shard_map(
+        from sparkdl_tpu.utils.jax_compat import shard_map
+
+        fn = shard_map(
             run, mesh=mesh, in_specs=in_specs, out_specs=P(),
             check_vma=False,
         )

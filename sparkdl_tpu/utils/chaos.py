@@ -1,0 +1,165 @@
+"""Fault-injection harness for gang fault-tolerance testing.
+
+The supervisor's retry/resume loop (:mod:`sparkdl_tpu.horovod.
+supervisor`) is only trustworthy if it has been exercised under an
+adversarial schedule — a preempted rank mid-step, a stalled
+rendezvous, dropped control-plane frames. This module provides those
+faults as **env-driven hooks**: entirely inert (a cached boolean
+check) unless a ``SPARKDL_TPU_CHAOS_*`` variable is set in the
+worker's environment, so production gangs pay nothing.
+
+Hook points:
+
+- ``chaos_step(step)`` — called by chaos-aware training mains once
+  per step: kills this process with the configured signal when this
+  rank/step matches (``KILL_RANK`` / ``KILL_STEP``). SIGKILL is the
+  default because that is what preemption looks like from the driver:
+  no EXC frame, a negative exit code.
+- ``on_worker_boot(rank)`` — called by ``_worker.py`` before the gang
+  rendezvous: stalls (``RENDEZVOUS_STALL_S``) or kills
+  (``KILL_PHASE=boot``) the chosen rank, exercising the launcher's
+  fail-fast rendezvous abort and start-timeout paths.
+- ``control_frame_fate(mtype)`` — consulted by the worker-side
+  control-plane client per frame: returns ``"drop"``, a delay in
+  seconds, or ``None`` (``CP_DROP`` / ``CP_DELAY_S``). Dropping READY
+  stalls the gang barrier; dropping RESULT exercises the lost-result
+  path. (The native log ring is not hooked: log frames are droppable
+  by design.)
+
+Env contract (all read in the WORKER process, so the launcher's
+per-gang env — or a test's monkeypatch before launch — scopes them):
+
+- ``SPARKDL_TPU_CHAOS_KILL_RANK``: rank to kill (int).
+- ``SPARKDL_TPU_CHAOS_KILL_STEP``: step at which ``chaos_step`` fires
+  (default 0).
+- ``SPARKDL_TPU_CHAOS_KILL_SIGNAL``: signal number (default SIGKILL).
+- ``SPARKDL_TPU_CHAOS_KILL_PHASE``: ``step`` (default) or ``boot``.
+- ``SPARKDL_TPU_CHAOS_ONCE_FILE``: path; the kill fires only if this
+  file does not exist and is claimed atomically first — ONE injected
+  death per path, so a supervised relaunch completes.
+- ``SPARKDL_TPU_CHAOS_RENDEZVOUS_STALL_S``: seconds to stall before
+  the rendezvous.
+- ``SPARKDL_TPU_CHAOS_RENDEZVOUS_STALL_RANK``: rank that stalls
+  (default: all ranks).
+- ``SPARKDL_TPU_CHAOS_CP_DELAY_S``: delay every control frame.
+- ``SPARKDL_TPU_CHAOS_CP_DROP``: comma list of frame names to drop:
+  READY, LOG, USERLOG, RESULT, EXC, BYE.
+"""
+
+import os
+import signal
+import time
+
+_PREFIX = "SPARKDL_TPU_CHAOS_"
+
+KILL_RANK_ENV = _PREFIX + "KILL_RANK"
+KILL_STEP_ENV = _PREFIX + "KILL_STEP"
+KILL_SIGNAL_ENV = _PREFIX + "KILL_SIGNAL"
+KILL_PHASE_ENV = _PREFIX + "KILL_PHASE"
+ONCE_FILE_ENV = _PREFIX + "ONCE_FILE"
+STALL_S_ENV = _PREFIX + "RENDEZVOUS_STALL_S"
+STALL_RANK_ENV = _PREFIX + "RENDEZVOUS_STALL_RANK"
+CP_DELAY_ENV = _PREFIX + "CP_DELAY_S"
+CP_DROP_ENV = _PREFIX + "CP_DROP"
+
+# Lazily-latched per process: gangs ship chaos env at spawn, so one
+# check at first hook call suffices and the common (chaos-off) path
+# stays a single `is False` test forever after.
+_active = None
+
+
+def _chaos_active():
+    global _active
+    if _active is None:
+        _active = any(k.startswith(_PREFIX) for k in os.environ)
+    return _active
+
+
+def _reset_cache_for_tests():
+    global _active
+    _active = None
+
+
+def _rank():
+    return int(os.environ.get("SPARKDL_TPU_RANK", "0"))
+
+
+def _claim_once():
+    """Atomically claim the one-shot kill token. True = this process
+    owns the kill. With no ONCE file configured every match kills
+    (the retry-budget-exhaustion schedule)."""
+    path = os.environ.get(ONCE_FILE_ENV)
+    if not path:
+        return True
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    except OSError:
+        return False  # unwritable token dir: fail safe, don't kill
+    os.close(fd)
+    return True
+
+
+def _kill_self():
+    sig = int(os.environ.get(KILL_SIGNAL_ENV, str(int(signal.SIGKILL))))
+    # Flush whatever the tee has buffered: the postmortem log should
+    # show the last step line before the "preemption".
+    try:
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    os.kill(os.getpid(), sig)
+    # A catchable signal (e.g. SIGTERM under test) may not have fired
+    # yet; give delivery a beat rather than racing ahead.
+    time.sleep(5)
+
+
+def chaos_step(step):
+    """Training-main hook: die here if this (rank, step) is the
+    configured kill point. No-op without chaos env."""
+    if not _chaos_active():
+        return
+    kill_rank = os.environ.get(KILL_RANK_ENV)
+    if kill_rank is None or int(kill_rank) != _rank():
+        return
+    if os.environ.get(KILL_PHASE_ENV, "step") != "step":
+        return
+    if int(step) != int(os.environ.get(KILL_STEP_ENV, "0")):
+        return
+    if _claim_once():
+        _kill_self()
+
+
+def on_worker_boot(rank):
+    """Worker bootstrap hook (before the gang rendezvous): stall or
+    kill the chosen rank. No-op without chaos env."""
+    if not _chaos_active():
+        return
+    stall = float(os.environ.get(STALL_S_ENV, "0") or 0)
+    if stall > 0:
+        stall_rank = os.environ.get(STALL_RANK_ENV)
+        if stall_rank is None or int(stall_rank) == rank:
+            time.sleep(stall)
+    if os.environ.get(KILL_PHASE_ENV) == "boot":
+        kill_rank = os.environ.get(KILL_RANK_ENV)
+        if kill_rank is not None and int(kill_rank) == rank:
+            if _claim_once():
+                _kill_self()
+
+
+def control_frame_fate(mtype_name):
+    """Control-plane client hook: ``"drop"``, a float delay in
+    seconds, or ``None`` for the given frame name."""
+    if not _chaos_active():
+        return None
+    drop = os.environ.get(CP_DROP_ENV, "")
+    if drop and mtype_name in {
+        t.strip().upper() for t in drop.split(",") if t.strip()
+    }:
+        return "drop"
+    delay = float(os.environ.get(CP_DELAY_ENV, "0") or 0)
+    return delay if delay > 0 else None

@@ -39,6 +39,26 @@ def should_save():
     return (not st.initialized) or st.rank == 0
 
 
+def latest_complete_step(directory):
+    """Newest COMMITTED checkpoint step under a TrainCheckpointer
+    root, by directory scan alone — no orbax (or jax) import, so the
+    gang supervisor can call it from the driver between relaunches
+    without initializing a backend the workers need. Orbax commits a
+    step by renaming its temp dir (suffixed, non-numeric) to the bare
+    step number, so numeric-named directories are exactly the durable
+    steps; a worker preempted mid-save leaves only a temp dir, which
+    this scan correctly ignores. Returns None when no step exists."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = [
+        int(n) for n in names
+        if n.isdigit() and os.path.isdir(os.path.join(directory, n))
+    ]
+    return max(steps, default=None)
+
+
 class TrainCheckpointer:
     """Step-indexed train-state checkpoints (params, opt_state, extras).
 

@@ -1,0 +1,52 @@
+"""Version-portable wrappers for jax APIs that moved between majors.
+
+The package targets current jax (``jax.shard_map``, ``check_vma``,
+``jax.lax.axis_size``), but deployment rigs pin older runtimes — and a
+framework whose collectives, MoE layers, and kernels all die with
+``AttributeError`` on jax 0.4.x has no fault-tolerance story at all.
+Every wrapper prefers the stable modern API and falls back to the
+0.4.x spelling:
+
+- ``shard_map``: ``jax.shard_map`` → ``jax.experimental.shard_map``
+  (where the replication checker kwarg was ``check_rep``, renamed
+  ``check_vma`` at promotion).
+- ``axis_size``: ``jax.lax.axis_size`` → the classic
+  ``psum(1, axis)``, a compile-time constant inside traced code
+  either way.
+"""
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **kwargs,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kwargs)
+
+
+def axis_size(name):
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` → pre-rename ``TPUCompilerParams``
+    (same constructor kwargs; ``dimension_semantics`` et al. carried
+    over unchanged at the rename)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

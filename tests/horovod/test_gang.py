@@ -321,13 +321,20 @@ def test_torch_fp16_compressed_allreduce():
 
 @pytest.mark.gang
 def test_gang_restart_on_failure(monkeypatch, tmp_path):
-    """SPARKDL_TPU_MAX_RESTARTS relaunches a failed gang (SURVEY.md
-    §5.3: relaunch IS the recovery story)."""
+    """SPARKDL_TPU_MAX_RESTARTS (legacy alias of
+    SPARKDL_TPU_GANG_MAX_RETRIES) relaunches a failed gang (SURVEY.md
+    §5.3: relaunch IS the recovery story). The failure is a
+    preemption-style SIGKILL: under the supervisor only TRANSIENT
+    failures consume the budget — user exceptions are never retried
+    (tests/horovod/test_fault_tolerance.py)."""
     monkeypatch.setenv("SPARKDL_TPU_MAX_RESTARTS", "2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_BASE", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_ABORT_GRACE", "5")
     marker = tmp_path / "attempts"
 
     def flaky_main(marker_path):
         import os
+        import signal
 
         import sparkdl_tpu.hvd as hvd
 
@@ -336,7 +343,7 @@ def test_gang_restart_on_failure(monkeypatch, tmp_path):
             with open(marker_path, "a") as fh:
                 fh.write("x")
             if os.path.getsize(marker_path) < 2:
-                raise RuntimeError("transient failure on first attempt")
+                os.kill(os.getpid(), signal.SIGKILL)  # "preempted"
         return "recovered"
 
     result = HorovodRunner(np=-2).run(flaky_main, marker_path=str(marker))

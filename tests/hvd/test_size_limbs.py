@@ -1,0 +1,53 @@
+"""The object-collective size codec (ADVICE high-severity fix).
+
+``broadcast_object``/``allgather_object`` exchange payload byte counts
+over a collective, and the engine canonicalizes dtypes when x64 is
+off: float64 → float32 (exact only to 2**24) and int64 → int32 (wraps
+at 2**31). The two-int32-limb codec (divmod 2**20) survives both.
+These tests pin the codec at the exact boundaries where the old
+float64 carrier silently rounded — no gang needed, the corruption was
+in the scalar representation itself.
+"""
+
+import numpy as np
+
+from sparkdl_tpu.hvd import _SIZE_LIMB, _size_from_limbs, _size_to_limbs
+
+
+def test_roundtrip_at_float32_boundary():
+    # 2**24 + 1 is the first payload size float32 cannot represent:
+    # the old float64 carrier, canonicalized to float32 by the engine,
+    # decoded it as 2**24 — a silent one-byte truncation that corrupts
+    # every later unpack offset. The limb codec is exact there.
+    n = 2**24 + 1
+    assert float(np.float32(n)) != n        # the bug being fixed
+    assert _size_from_limbs(_size_to_limbs(n)) == n
+
+
+def test_roundtrip_across_the_corruption_window():
+    # The whole silently-rounded window (~16.7 MB .. 2 GiB) plus the
+    # edges around it and the guard boundary.
+    for n in (0, 1, _SIZE_LIMB - 1, _SIZE_LIMB, _SIZE_LIMB + 1,
+              2**24 - 1, 2**24, 2**24 + 1, 123_456_789,
+              2**31 - 1, 2**31, 5 << 30, 2**40 + 7):
+        assert _size_from_limbs(_size_to_limbs(n)) == n
+
+
+def test_limbs_survive_int32_canonicalization():
+    # Both limbs must already BE int32 (and small enough that int32
+    # canonicalization is the identity) for any size the < 2 GiB
+    # payload guard admits — and well beyond it, to 2**51.
+    for n in (2**24 + 1, 2**31 - 1, 2**45):
+        limbs = _size_to_limbs(n)
+        assert limbs.dtype == np.int32
+        assert _size_from_limbs(limbs.astype(np.int64).astype(np.int32)) == n
+
+
+def test_float64_carrier_would_have_rounded():
+    # Regression documentation: simulate the old path (size as float64,
+    # canonicalized to float32 by the engine) and show it misdecodes
+    # exactly where the limb codec is exact.
+    for n in (2**24 + 1, 50_000_001, 2**30 + 3):
+        old = int(np.float32(np.float64(n)))
+        assert old != n
+        assert _size_from_limbs(_size_to_limbs(n)) == n

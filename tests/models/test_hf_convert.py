@@ -83,8 +83,8 @@ def test_roundtrip_and_export_to_hf(hf_pair):
     sd = params_to_hf(params, cfg)
     back = params_from_hf(sd, cfg)
     for (p1, l1), (p2, l2) in zip(
-            jax.tree.flatten_with_path(params)[0],
-            jax.tree.flatten_with_path(back)[0]):
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0]):
         assert jax.tree_util.keystr(p1) == jax.tree_util.keystr(p2)
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
 
@@ -130,6 +130,43 @@ def test_hf_checkpoint_through_the_serving_stack(hf_pair):
                       temperature=0.0)
     np.testing.assert_array_equal(out[rid],
                                   np.asarray(oracle)[0, 6:])
+
+
+def test_bfloat16_conversion_covers_every_kernel(hf_pair):
+    """``dtype=bfloat16`` must reach EVERY kernel — the lm_head
+    included, in both its branches (regression: the lm_head was pinned
+    fp32, silently doubling the largest matrix in a serving tree) —
+    and the converted tree must still track the HF torch forward to
+    bf16 tolerance."""
+    hf_model, cfg, params = hf_pair
+
+    def kernels(tree, path=()):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                yield from kernels(v, path + (k,))
+            elif k in ("kernel", "embedding"):
+                yield path + (k,), v
+
+    sd = hf_model.state_dict()
+    p16 = params_from_hf(sd, cfg, dtype=jnp.bfloat16)
+    for path, leaf in kernels(p16):
+        assert leaf.dtype == jnp.bfloat16, path
+    # tied-embedding branch: same rule
+    tied = params_from_hf(
+        {k: v for k, v in sd.items() if k != "lm_head.weight"}, cfg,
+        dtype=jnp.bfloat16)
+    assert tied["lm_head"]["kernel"].dtype == jnp.bfloat16
+    # norm scales deliberately stay fp32 (documented exception)
+    assert p16["final_norm"]["scale"].dtype == jnp.float32
+
+    # torch-parity, bf16 tolerance: the cast costs ~3 decimal digits
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    ours16 = np.asarray(Llama(cfg).apply(
+        {"params": p16}, jnp.asarray(tokens, jnp.int32))).astype(np.float32)
+    np.testing.assert_allclose(ours16, ref, atol=0.15, rtol=0.1)
 
 
 def test_conversion_refuses_what_it_cannot_map(hf_pair):

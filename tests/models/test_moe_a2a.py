@@ -53,10 +53,10 @@ def test_no_drop_gradients_match_dense(setup):
     g_ref = jax.grad(
         lambda p, x_: (moe.apply({"params": p}, x_) * w).sum(),
         argnums=(0, 1))(params, x)
-    flat_a, _ = jax.tree.flatten_with_path(g_a2a)
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(g_a2a)
     flat_r = dict(
         (jax.tree_util.keystr(p), v)
-        for p, v in jax.tree.flatten_with_path(g_ref)[0])
+        for p, v in jax.tree_util.tree_flatten_with_path(g_ref)[0])
     for path, got in flat_a:
         name = jax.tree_util.keystr(path)
         np.testing.assert_allclose(

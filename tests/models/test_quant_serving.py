@@ -149,7 +149,7 @@ class TestInt4:
         _, saved8 = quantize_params(np_params, bits=8)
         _, saved4 = quantize_params(np_params, bits=4)
         exp8 = exp4 = 0
-        for path, leaf in jax.tree.flatten_with_path(np_params)[0]:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(np_params)[0]:
             name = jax.tree_util.keystr(path)
             if leaf.ndim == 2 and "kernel" in name and any(
                     t in name for t in

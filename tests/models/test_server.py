@@ -147,13 +147,108 @@ def test_engine_fault_recovery():
         p = np.arange(1, 7, dtype=np.int32)
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(fe, {"tokens": p.tolist(), "max_new_tokens": 4})
-        assert e.value.code == 400
+        # the ENGINE broke on an admitted request: 500, never 400 —
+        # the client sent nothing wrong
+        assert e.value.code == 500
         assert "engine error" in str(e.value.reason)
         # server recovered: the next request serves correctly
         out = _post(fe, {"tokens": p.tolist(), "max_new_tokens": 4})
         oracle = generate(model, params, p[None], max_new_tokens=4,
                           temperature=0.0)
         assert out["tokens"] == np.asarray(oracle)[0, 6:].tolist()
+    finally:
+        fe.close()
+
+
+class _FakeCfg:
+    max_cache_len = 64
+
+
+class _FakeEngine:
+    """Engine-shaped stub: lets the handler tests pin the HTTP status
+    classification without paying for a model. ``fault`` controls what
+    run() does: None = serve, an Exception instance = engine fault
+    (500), a BaseException instance = loop death (503)."""
+
+    def __init__(self, fault=None):
+        self.cfg = _FakeCfg()
+        self.fault = fault
+        self.finish_reasons = {}
+        self.logprobs = {}
+        self._queued = {}
+        self._next = 0
+
+    def _worst_case_tokens(self, prompt_len, max_new):
+        return prompt_len + max_new
+
+    def submit(self, tokens, max_new_tokens, stop=None):
+        rid = self._next
+        self._next += 1
+        self._queued[rid] = max_new_tokens
+        return rid
+
+    def run(self, progress=None, on_token=None):
+        if self.fault is not None:
+            fault, self.fault = self.fault, None
+            raise fault
+        out = {}
+        for rid, n in self._queued.items():
+            out[rid] = np.arange(n, dtype=np.int32)
+            self.finish_reasons[rid] = "length"
+            self.logprobs[rid] = [0.0] * n
+        self._queued.clear()
+        return out
+
+    def abort_requests(self):
+        self._queued.clear()
+
+
+def _post_raw(fe, payload):
+    req = urllib.request.Request(
+        f"http://{fe.address[0]}:{fe.address[1]}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def test_status_classification_400_500_then_recovery():
+    """The full fault taxonomy on one server: validation 400, engine
+    fault 500, then the same server serves 200 (fault recovery)."""
+    # multi-line fault text: send_error puts the message on the HTTP
+    # status line, so the server must collapse it or the 500 would
+    # arrive as a corrupted/split response
+    fe = ServingFrontend(_FakeEngine(
+        fault=RuntimeError("XLA ate a core\n  backtrace line\n  ünicode"))
+    ).start()
+    try:
+        # request's fault: 400 (budget exceeds max_cache_len)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 1000})
+        assert e.value.code == 400
+        # engine's fault: 500
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 4})
+        assert e.value.code == 500
+        assert "engine error: XLA ate a core" in str(e.value.reason)
+        assert "\n" not in str(e.value.reason)
+        # recovered: 200 with tokens
+        with _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 3}) as r:
+            assert json.loads(r.read())["tokens"] == [0, 1, 2]
+    finally:
+        fe.close()
+
+
+def test_loop_death_fails_waiters_with_503():
+    """A dead engine loop (non-Exception escape) must fail waiters
+    with 503 — 'retry elsewhere', not 'your request was bad'."""
+    fe = ServingFrontend(_FakeEngine(
+        fault=KeyboardInterrupt())).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 4})
+        assert e.value.code == 503
+        assert "shutting down" in str(e.value.reason)
     finally:
         fe.close()
 

@@ -4,6 +4,8 @@ reference lacks entirely)."""
 
 import jax
 import jax.numpy as jnp
+
+from sparkdl_tpu.utils.jax_compat import shard_map
 import numpy as np
 import pytest
 
@@ -49,7 +51,7 @@ def test_ring_gradients_match_dense(mesh_2x4):
     from sparkdl_tpu.parallel.ring_attention import ring_self_attention
 
     spec = P("data", "seq", None, None)
-    ring = jax.shard_map(
+    ring = shard_map(
         partial(ring_self_attention, axis_name="seq", causal=True),
         mesh=mesh_2x4, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
@@ -115,7 +117,7 @@ class TestRingFlash:
         w = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
 
         spec = P("data", "seq", None, None)
-        ring = jax.shard_map(
+        ring = shard_map(
             partial(ring_flash_attention, axis_name="seq",
                     causal=causal, interpret=True),
             mesh=mesh_2x4, in_specs=(spec, spec, spec), out_specs=spec,
@@ -156,7 +158,7 @@ def test_llama_trains_with_ring_flash(mesh_2x4):
     qkv_spec = P(("data",), "seq", None, None)
 
     def ring(impl_fn):
-        return jax.shard_map(
+        return shard_map(
             partial(impl_fn, axis_name="seq", causal=True),
             mesh=mesh_2x4,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
@@ -190,8 +192,8 @@ def test_llama_trains_with_ring_flash(mesh_2x4):
     np.testing.assert_allclose(float(losses["flash"]),
                                float(losses["dense"]), rtol=1e-5)
     flat_d = {jax.tree_util.keystr(p): v for p, v
-              in jax.tree.flatten_with_path(grads["dense"])[0]}
-    for path, got in jax.tree.flatten_with_path(grads["flash"])[0]:
+              in jax.tree_util.tree_flatten_with_path(grads["dense"])[0]}
+    for path, got in jax.tree_util.tree_flatten_with_path(grads["flash"])[0]:
         name = jax.tree_util.keystr(path)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(flat_d[name]),

@@ -116,8 +116,8 @@ def test_multichip_updated_params_keep_their_shardings():
 
     compiled, shardings = _compiled_8dev()
     out_params = compiled.output_shardings[0]
-    want_flat, _ = jax.tree.flatten_with_path(shardings)
-    got_flat, _ = jax.tree.flatten_with_path(out_params)
+    want_flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    got_flat, _ = jax.tree_util.tree_flatten_with_path(out_params)
     got = {jax.tree_util.keystr(p): s for p, s in got_flat}
 
     def norm(sharding):
