@@ -1,0 +1,203 @@
+"""``sparkdl_tpu.analysis``: static graph-lint over jaxprs and lowered
+StableHLO/HLO, run on the driver *before* a gang spends chip-hours.
+
+The failure modes it exists for are the silent, expensive ones —
+collective-order divergence that deadlocks the gang, a lost sharding
+constraint that regathers a full TP parameter every step, f64 values
+silently canonicalized to f32 (the PR 1 payload-size bug class), and
+host callbacks that stall every rank every step.
+
+Entry points:
+
+- :func:`lint_fn` — trace/lower/compile a step and run every pass.
+- :func:`lint_lowered` / :func:`lint_compiled` — lint an artifact the
+  caller already has (e.g. from
+  :func:`sparkdl_tpu.parallel.train.lower_train_step`).
+- :func:`lint_gang` — cross-rank collective-consistency over one
+  program per rank (the ``per_rank_kwargs`` case).
+- the CLI: ``python -m sparkdl_tpu.analysis`` (AST lint over source
+  files, ``--self`` for the repo itself, ``--graft N`` for the
+  multichip driver program).
+- the launcher pre-flight: ``SPARKDL_TPU_PREFLIGHT_LINT=1`` (see
+  :mod:`sparkdl_tpu.analysis.preflight`).
+
+Importing this package never imports jax — the launcher touches it on
+every gang start and must stay import-light on the driver.
+"""
+
+from sparkdl_tpu.analysis.core import (
+    Finding,
+    GraphContext,
+    ParamInfo,
+    Severity,
+    all_passes,
+    max_severity,
+    register_pass,
+    run_passes,
+)
+from sparkdl_tpu.analysis.preflight import (
+    PREFLIGHT_ENV,
+    PreflightLintError,
+    register_preflight,
+)
+
+__all__ = [
+    "Finding", "GraphContext", "ParamInfo", "Severity", "all_passes",
+    "max_severity", "register_pass", "run_passes", "lint_fn",
+    "lint_lowered", "lint_compiled", "lint_gang", "param_info_from",
+    "PreflightLintError", "PREFLIGHT_ENV", "register_preflight",
+]
+
+
+def param_info_from(params, shardings):
+    """:class:`ParamInfo` list from matching (params, shardings)
+    pytrees — params may be arrays or ShapeDtypeStructs; shardings are
+    NamedShardings (or PartitionSpec-like). Only axes with mesh size >
+    1 count as sharded (XLA normalizes size-1 axes away)."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    p_flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    s_flat, _ = jax.tree_util.tree_flatten_with_path(
+        shardings,
+        is_leaf=lambda x: hasattr(x, "spec")
+        or isinstance(x, PartitionSpec),
+    )
+    s_by_path = {jax.tree_util.keystr(p): s for p, s in s_flat}
+    out = []
+    for path, leaf in p_flat:
+        key = jax.tree_util.keystr(path)
+        sh = s_by_path.get(key)
+        axes = ()
+        spec = None
+        if sh is not None and hasattr(sh, "spec"):
+            spec = sh.spec
+        elif isinstance(sh, PartitionSpec):
+            # A bare PartitionSpec has no mesh: every named axis
+            # counts as sharded (assuming size 1 instead would make
+            # the all-gather pass vacuously green).
+            spec = sh
+        if spec is not None:
+            mesh_sizes = dict(
+                zip(sh.mesh.axis_names, sh.mesh.devices.shape)
+            ) if hasattr(sh, "mesh") else {}
+            names = []
+            for entry in spec:
+                if entry is None:
+                    continue
+                for n in (entry if isinstance(entry, tuple) else (entry,)):
+                    if n is not None and mesh_sizes.get(n, 2) > 1:
+                        names.append(str(n))
+            axes = tuple(names)
+        out.append(ParamInfo(
+            path=key,
+            shape=tuple(int(d) for d in leaf.shape),
+            dtype=str(leaf.dtype),
+            sharded_axes=axes,
+        ))
+    return out
+
+
+def _context_for(fn, args, *, compile=True, params=None, shardings=None,
+                 mesh=None, name=None, options=None):
+    import contextlib
+
+    from sparkdl_tpu.utils import jax_compat
+
+    ctx_mgr = mesh if mesh is not None else contextlib.nullcontext()
+    jaxpr = hlo_text = stablehlo = None
+    with ctx_mgr:
+        try:
+            jaxpr = jax_compat.closed_jaxpr(fn, *args)
+        except Exception:
+            jaxpr = None
+        lowered = jax_compat.lower(fn, *args)
+        stablehlo = jax_compat.lowered_stablehlo(lowered)
+        if compile:
+            hlo_text = jax_compat.compiled_hlo(lowered)
+    info = None
+    if params is not None and shardings is not None:
+        info = param_info_from(params, shardings)
+    return GraphContext(
+        fn_name=name or getattr(fn, "__name__", "<fn>"),
+        jaxpr=jaxpr,
+        hlo_text=hlo_text,
+        stablehlo_text=stablehlo,
+        param_info=info,
+        example_args=tuple(args),
+        fn=fn,
+        x64_enabled=jax_compat.x64_enabled(),
+        options=options or {},
+    )
+
+
+def lint_fn(fn, *args, compile=True, params=None, shardings=None,
+            mesh=None, passes=None, name=None, options=None):
+    """Trace, lower, (optionally) compile ``fn(*args)`` and run the
+    graph passes. ``params``/``shardings`` feed the full-param
+    all-gather pass; ``mesh`` is entered around lowering when given.
+    Returns findings sorted most-severe first."""
+    ctx = _context_for(
+        fn, args, compile=compile, params=params, shardings=shardings,
+        mesh=mesh, name=name, options=options,
+    )
+    return run_passes(ctx, passes=passes)
+
+
+def lint_lowered(lowered, *, params=None, shardings=None, compile=True,
+                 passes=None, name=None, options=None):
+    """Lint an existing ``jax.stages.Lowered`` (compiling it for the
+    post-partitioning passes unless ``compile=False``)."""
+    from sparkdl_tpu.utils import jax_compat
+
+    info = None
+    if params is not None and shardings is not None:
+        info = param_info_from(params, shardings)
+    ctx = GraphContext(
+        fn_name=name or "<lowered>",
+        jaxpr=getattr(lowered, "jaxpr", None),
+        hlo_text=jax_compat.compiled_hlo(lowered) if compile else None,
+        stablehlo_text=jax_compat.lowered_stablehlo(lowered),
+        param_info=info,
+        x64_enabled=jax_compat.x64_enabled(),
+        options=options or {},
+    )
+    return run_passes(ctx, passes=passes)
+
+
+def lint_compiled(compiled, *, params=None, shardings=None, passes=None,
+                  name=None, options=None):
+    """Lint an already-``Compiled`` executable's optimized HLO."""
+    from sparkdl_tpu.utils import jax_compat
+
+    info = None
+    if params is not None and shardings is not None:
+        info = param_info_from(params, shardings)
+    ctx = GraphContext(
+        fn_name=name or "<compiled>",
+        hlo_text=compiled.as_text(),
+        param_info=info,
+        x64_enabled=jax_compat.x64_enabled(),
+        options=options or {},
+    )
+    return run_passes(ctx, passes=passes)
+
+
+def lint_gang(fns_or_jaxprs, args_per_rank=None, names=None):
+    """Cross-rank collective consistency: one program per rank. Pass
+    either ClosedJaxprs, or callables plus ``args_per_rank`` (one args
+    tuple per rank) to trace here."""
+    from sparkdl_tpu.analysis.passes_collectives import (
+        check_gang_consistency,
+    )
+    from sparkdl_tpu.utils import jax_compat
+
+    jaxprs = []
+    for i, obj in enumerate(fns_or_jaxprs):
+        if callable(obj) and not hasattr(obj, "eqns") \
+                and not hasattr(obj, "jaxpr"):
+            args = args_per_rank[i] if args_per_rank else ()
+            jaxprs.append(jax_compat.closed_jaxpr(obj, *args))
+        else:
+            jaxprs.append(obj)
+    return check_gang_consistency(jaxprs, names=names)

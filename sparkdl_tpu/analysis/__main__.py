@@ -1,0 +1,130 @@
+"""CLI: ``python -m sparkdl_tpu.analysis``.
+
+Modes compose in one invocation; exit status is 1 when any finding
+reaches ``--fail-on`` (default: error), so CI can gate on it.
+
+- positional paths: AST lint (pickling contract) over ``.py``
+  files/directories — cheap, no jax import.
+- ``--self``: the same AST lint over the repo's own surface
+  (``sparkdl_tpu/``, ``examples/``, ``__graft_entry__.py``).
+- ``--graft N``: build the N-device multichip driver program
+  (``__graft_entry__.build_multichip_step``) and run the full graph
+  pass suite over its jaxpr + compiled HLO — the deepest check, and
+  the same artifact the tier-1 HLO canaries assert on.
+"""
+
+import argparse
+import json
+import sys
+
+from sparkdl_tpu.analysis.core import Severity, max_severity
+
+
+def _graft_findings(n_devices):
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import importlib.util
+    from pathlib import Path
+
+    import sparkdl_tpu
+
+    entry = Path(sparkdl_tpu.__file__).parent.parent / "__graft_entry__.py"
+    if not entry.exists():
+        raise SystemExit(
+            f"--graft needs the repo checkout ({entry} not found)"
+        )
+    spec = importlib.util.spec_from_file_location("graft_entry", entry)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    step, params, opt_state, batch, mesh, shardings = \
+        mod.build_multichip_step(n_devices)
+    from sparkdl_tpu.analysis import lint_fn
+
+    # lint_fn (not lint_lowered) so the jaxpr-level passes — collective
+    # consistency, host-sync — see through the step, not just its
+    # compiled HLO.
+    return lint_fn(
+        step, params, opt_state, batch, mesh=mesh,
+        params=params, shardings=shardings,
+        name=f"build_multichip_step({n_devices})",
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m sparkdl_tpu.analysis",
+        description="Static graph/source lint for sparkdl_tpu programs.",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help=".py files or directories for the AST (pickling-contract) "
+             "lint",
+    )
+    parser.add_argument(
+        "--self", dest="self_lint", action="store_true",
+        help="lint the repo's own package, examples/, and driver entry",
+    )
+    parser.add_argument(
+        "--graft", type=int, metavar="N", default=None,
+        help="graph-lint the N-device multichip driver program",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--fail-on", default="error",
+        choices=("error", "warning", "info", "never"),
+        help="exit 1 when any finding reaches this severity "
+             "(default: error)",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true",
+        help="print the registered graph passes and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        from sparkdl_tpu.analysis.core import all_passes
+
+        for rule_id, p in all_passes().items():
+            print(f"{rule_id:28s} requires={','.join(p.requires) or '-'}"
+                  f"  {p.doc}")
+        return 0
+
+    from sparkdl_tpu.analysis.selflint import lint_paths, self_targets
+
+    findings = []
+    targets = list(args.paths)
+    if args.self_lint:
+        targets.extend(self_targets())
+    if targets:
+        findings.extend(lint_paths(targets))
+    if args.graft is not None:
+        findings.extend(_graft_findings(args.graft))
+    if not targets and args.graft is None:
+        parser.error("nothing to lint: give paths, --self, or --graft N")
+
+    findings.sort(key=lambda f: -int(f.severity))
+    if args.format == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n_err = sum(1 for f in findings if f.severity == Severity.ERROR)
+        n_warn = sum(1 for f in findings if f.severity == Severity.WARNING)
+        print(f"-- {len(findings)} finding(s): {n_err} error(s), "
+              f"{n_warn} warning(s)")
+    if args.fail_on != "never":
+        top = max_severity(findings)
+        if top is not None and top >= Severity.parse(args.fail_on):
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

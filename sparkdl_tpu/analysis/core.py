@@ -1,0 +1,172 @@
+"""Pass framework for static analysis of jitted programs.
+
+The unit of work is a :class:`GraphContext` — one traced/lowered
+program plus whatever side information the caller can supply (param
+shardings, example args) — and a *pass* is a function
+``(ctx) -> iterable[Finding]`` registered under a stable rule id.
+Passes degrade gracefully: a pass whose required artifact (say the
+compiled HLO) is missing from the context simply does not run, so the
+same registry serves the cheap jaxpr-only preflight on the driver and
+the full compiled-HLO audit in tests/CI.
+
+Severity contract (stable — the CLI exit code and the launcher
+pre-flight key off it):
+
+- ``ERROR``   — the gang will deadlock, silently corrupt numerics, or
+  burn chip-hours; the pre-flight refuses to launch.
+- ``WARNING`` — heuristic or perf-level: worth a look, never blocks.
+- ``INFO``    — diagnostics (e.g. a pass that could not run).
+"""
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    @classmethod
+    def parse(cls, name):
+        try:
+            return cls[name.upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {name!r}; expected one of "
+                f"{[s.name.lower() for s in cls]}"
+            )
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic from one pass over one program."""
+
+    rule_id: str
+    severity: Severity
+    op: str          # the offending op/primitive/name ("" if N/A)
+    location: str    # user-source "file:line" when recoverable, else ""
+    message: str
+
+    def __str__(self):
+        loc = f" [{self.location}]" if self.location else ""
+        op = f" {self.op}:" if self.op else ""
+        return (f"{self.severity.name:7s} {self.rule_id}{loc}{op} "
+                f"{self.message}")
+
+    def to_dict(self):
+        return {
+            "rule_id": self.rule_id,
+            "severity": self.severity.name,
+            "op": self.op,
+            "location": self.location,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ParamInfo:
+    """One parameter leaf as the graph passes see it: full (unsharded)
+    shape/dtype plus the mesh axes its sharding actually splits it
+    over (axes of size 1 don't count — XLA normalizes them away)."""
+
+    path: str
+    shape: tuple
+    dtype: str
+    sharded_axes: tuple
+
+    @property
+    def elements(self):
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+
+@dataclass
+class GraphContext:
+    """Everything a pass may look at. All fields optional — passes
+    declare what they require and are skipped when it is absent."""
+
+    fn_name: str = "<fn>"
+    jaxpr: object = None          # jax.core.ClosedJaxpr
+    hlo_text: str = None          # post-SPMD compiled HLO (Compiled.as_text())
+    stablehlo_text: str = None    # Lowered.as_text()
+    param_info: list = None       # list[ParamInfo] for TP-sharded params
+    example_args: tuple = None    # the concrete/abstract args traced with
+    fn: object = None             # the callable itself (shadow retraces)
+    x64_enabled: bool = None      # jax_enable_x64 at trace time
+    options: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class GraphPass:
+    rule_id: str
+    fn: object
+    requires: tuple
+    doc: str
+
+
+_REGISTRY = {}
+
+
+def register_pass(rule_id, requires=()):
+    """Register ``fn(ctx) -> iterable[Finding]`` under ``rule_id``.
+    ``requires`` names GraphContext fields that must be non-None for
+    the pass to run (it is silently skipped otherwise)."""
+
+    def deco(fn):
+        _REGISTRY[rule_id] = GraphPass(
+            rule_id=rule_id, fn=fn, requires=tuple(requires),
+            doc=(fn.__doc__ or "").strip().split("\n")[0],
+        )
+        return fn
+
+    return deco
+
+
+def all_passes():
+    """rule_id -> GraphPass, registration order preserved."""
+    _load_builtin_passes()
+    return dict(_REGISTRY)
+
+
+def _load_builtin_passes():
+    # Import for side effect of registration; lazy so `import
+    # sparkdl_tpu.analysis` stays jax-free.
+    from sparkdl_tpu.analysis import (  # noqa: F401
+        passes_collectives,
+        passes_dtype,
+        passes_host,
+    )
+
+
+def run_passes(ctx, passes=None):
+    """Run ``passes`` (default: all registered) over ``ctx``; findings
+    come back sorted most-severe first, source order within a
+    severity."""
+    _load_builtin_passes()
+    if passes is None:
+        selected = list(_REGISTRY.values())
+    else:
+        selected = []
+        for p in passes:
+            if isinstance(p, str):
+                if p not in _REGISTRY:
+                    raise ValueError(
+                        f"unknown pass {p!r}; registered: "
+                        f"{sorted(_REGISTRY)}"
+                    )
+                selected.append(_REGISTRY[p])
+            else:
+                selected.append(p)
+    findings = []
+    for p in selected:
+        if any(getattr(ctx, r, None) is None for r in p.requires):
+            continue
+        findings.extend(p.fn(ctx))
+    return sorted(findings, key=lambda f: -int(f.severity))
+
+
+def max_severity(findings):
+    return max((f.severity for f in findings), default=None)

@@ -1,0 +1,204 @@
+"""Textual analysis of lowered/compiled XLA modules.
+
+Post-SPMD-partitioning HLO text (``compiled.as_text()``) is where
+collectives become visible as concrete ops with replica groups — the
+same artifact GSPMD-style partitioners reason about. The parser here is
+deliberately line-oriented and regex-based: HLO text is stable enough
+for that (the repo's multichip canaries have grepped it since the
+seed), and a structural parse would tie us to jaxlib internals.
+"""
+
+import re
+from dataclasses import dataclass
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+)
+
+# `%x = f32[2,8]{1,0} all-gather(...)` or tuple-typed
+# `%x = (f32[8]{0}, u32[]) all-reduce(...)`; "-start" variants are the
+# async halves of the same op.
+_OP_RE = re.compile(
+    r"=\s*(?P<rtype>\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\("
+)
+_TYPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# Explicit `{{0,1},{2,3}}` and iota `[2,4]<=[8]` (optionally
+# transposed `T(1,0)`) group encodings both appear in optimized HLO.
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{.*?\}\}|\{\}"
+    r"|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)"
+)
+
+# numpy dtype name -> HLO shorthand, for matching ParamInfo dtypes
+# against compiled-HLO result types.
+_HLO_DTYPES = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "float8_e4m3fn": "f8e4m3fn",
+    "float8_e5m2": "f8e5m2",
+    "int64": "s64", "int32": "s32", "int16": "s16", "int8": "s8",
+    "uint64": "u64", "uint32": "u32", "uint16": "u16", "uint8": "u8",
+    "bool": "pred", "complex64": "c64", "complex128": "c128",
+}
+
+
+def to_hlo_dtype(name):
+    """'float32' -> 'f32' (unknown names pass through unchanged, so an
+    exotic dtype degrades to never-matching rather than crashing)."""
+    return _HLO_DTYPES.get(str(name), str(name))
+
+
+@dataclass(frozen=True)
+class HloCollective:
+    """One collective op in program order."""
+
+    kind: str
+    dtype: str            # dtype of the first/only result element
+    shape: tuple
+    replica_groups: str   # raw text, "{}" when unconstrained
+    index: int            # order of appearance in the module text
+    line: str
+    result_types: tuple   # ((dtype, shape), ...) for tuple-typed results
+
+    @property
+    def elements(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+
+def _parse_result_types(rtype):
+    out = []
+    for dtype, dims in _TYPE_RE.findall(rtype):
+        shape = tuple(int(d) for d in dims.split(",") if d)
+        out.append((dtype, shape))
+    return tuple(out)
+
+
+def collectives(hlo_text):
+    """Ordered list of :class:`HloCollective` in the module text."""
+    out = []
+    for i, line in enumerate(hlo_text.splitlines()):
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        rtypes = _parse_result_types(m.group("rtype"))
+        if not rtypes:
+            continue
+        g = _GROUPS_RE.search(line)
+        dtype, shape = rtypes[0]
+        out.append(HloCollective(
+            kind=m.group("kind"),
+            dtype=dtype,
+            shape=shape,
+            replica_groups=g.group(1) if g else "{}",
+            index=len(out),
+            line=line.strip(),
+            result_types=rtypes,
+        ))
+    return out
+
+
+_IOTA_RE = re.compile(
+    r"\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?$"
+)
+
+
+def _groups_of(col):
+    """replica_groups text -> list of device-id lists ([] = all)."""
+    text = col.replica_groups
+    m = _IOTA_RE.match(text)
+    if m:
+        import numpy as np
+
+        group_shape = [int(x) for x in m.group(1).split(",")]
+        iota_shape = [int(x) for x in m.group(2).split(",")]
+        arr = np.arange(int(np.prod(iota_shape))).reshape(iota_shape)
+        if m.group(3):
+            arr = arr.transpose([int(x) for x in m.group(3).split(",")])
+        return [list(map(int, row)) for row in arr.reshape(group_shape)]
+    body = text.strip("{}")
+    if not body:
+        return []
+    return [
+        [int(x) for x in grp.split(",") if x.strip()]
+        for grp in re.findall(r"\{([0-9, ]*)\}", text)
+    ]
+
+
+def role_sequences(cols):
+    """Per-mesh-role ordered collective signatures.
+
+    A *role* is a set of devices that traverse the same collective
+    sequence; in a partitioned module, the sequence a device sees is
+    the ordered list of collectives whose replica_groups contain it
+    (ops with empty groups involve every device). Returns
+    ``{role_key: [(kind, dtype, group_signature), ...]}`` where
+    ``role_key`` is a representative frozenset of device ids ("*" for
+    the all-devices role).
+
+    Two roles with *different* (kind, dtype) sequences cannot be
+    proven deadlock-free from the text alone — that is the divergence
+    the collective-consistency pass reports.
+    """
+    seqs = {}
+    device_ids = set()
+    for col in cols:
+        for grp in _groups_of(col):
+            device_ids.update(grp)
+    if not device_ids:
+        device_ids = {"*"}
+    for dev in sorted(device_ids, key=str):
+        seq = []
+        for col in cols:
+            groups = _groups_of(col)
+            if not groups:
+                member = True
+                sig = "{}"
+            else:
+                member = any(dev in g for g in groups)
+                sig = next(
+                    (",".join(map(str, g)) for g in groups if dev in g),
+                    "",
+                )
+            if member:
+                seq.append((col.kind, col.dtype, sig))
+        seqs[dev] = seq
+    # Collapse identical sequences into roles.
+    roles = {}
+    for dev, seq in seqs.items():
+        roles.setdefault(tuple(seq), []).append(dev)
+    return {
+        frozenset(devs): list(seq) for seq, devs in roles.items()
+    }
+
+
+HOST_SYNC_PATTERNS = (
+    # custom-call targets jax uses for host callbacks
+    (re.compile(r'custom-call.*custom_call_target="'
+                r'(xla_python_cpu_callback[^"]*|xla_ffi_python[^"]*'
+                r'|tpu_callback[^"]*|xla_python_gpu_callback[^"]*)"'),
+     "host callback custom-call"),
+    (re.compile(r"=\s*\S+\s+infeed\("), "infeed from host"),
+    (re.compile(r"=\s*\S+\s+outfeed\("), "outfeed to host"),
+    (re.compile(r"=\s*\S+\s+(send|recv)(?:-done)?\(.*is_host_transfer=true"),
+     "host transfer send/recv"),
+)
+
+
+def host_sync_ops(hlo_text):
+    """(label, line) for every op that forces a device<->host round
+    trip inside the program."""
+    out = []
+    for line in hlo_text.splitlines():
+        for pat, label in HOST_SYNC_PATTERNS:
+            if pat.search(line):
+                out.append((label, line.strip()))
+                break
+    return out

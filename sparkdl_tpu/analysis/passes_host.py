@@ -1,0 +1,91 @@
+"""Host-sync-in-step pass.
+
+A host callback (``jax.pure_callback``, ``io_callback``,
+``jax.debug.callback`` / ``jax.debug.print``, legacy host_callback
+``outside_call``) or an infeed/outfeed inside the jitted train step
+forces a device→host→device round trip *every step*: the TPU stalls
+while Python runs, and on a gang every rank stalls together. Python
+scalars riding in as arguments are the softer cousin — weak-typed
+promotion drift plus a retrace whenever the Python type changes.
+
+Debug prints are flagged at the same severity as other callbacks:
+the pass exists to catch exactly the "it trained fine on 8 chips, why
+is the pod 40x slower" class, where a forgotten ``jax.debug.print``
+is the classic cause.
+"""
+
+from sparkdl_tpu.analysis import hlo as hlo_mod
+from sparkdl_tpu.analysis import jaxpr_walk
+from sparkdl_tpu.analysis.core import Finding, Severity, register_pass
+
+_RULE = "host-sync-in-step"
+
+
+@register_pass(_RULE)  # requires jaxpr OR hlo_text: checked inline
+def host_sync_in_step(ctx):
+    """Flag device↔host transfers, callbacks, and Python-scalar
+    weak-type leaks inside the jitted step."""
+    findings = []
+    for eqn, path in jaxpr_walk.callbacks(ctx.jaxpr) \
+            if ctx.jaxpr is not None else ():
+        name = eqn.primitive.name
+        inside = " inside " + "/".join(p for p, _, _ in path) if path else ""
+        findings.append(Finding(
+            rule_id=_RULE,
+            severity=Severity.ERROR,
+            op=name,
+            location=jaxpr_walk.source_location(eqn),
+            message=(
+                f"host callback `{name}`{inside} blocks the device on "
+                "a device→host→device round trip every step (every "
+                "rank of a gang stalls together). Move it out of the "
+                "step, or run it on a metrics cadence outside jit."
+            ),
+        ))
+    jaxpr_found_callbacks = bool(findings)
+    if ctx.example_args is not None:
+        findings.extend(_scalar_findings(ctx.example_args))
+    if ctx.hlo_text is not None:
+        for label, line in hlo_mod.host_sync_ops(ctx.hlo_text):
+            # The jaxpr walk already names callbacks better (with
+            # source locations); the HLO scan catches what slipped in
+            # below jaxpr level (custom lowering rules, infeed) or
+            # when only a lowered/compiled artifact is available.
+            if jaxpr_found_callbacks:
+                continue
+            findings.append(Finding(
+                rule_id=_RULE,
+                severity=Severity.ERROR,
+                op=label,
+                location="",
+                message=(
+                    f"{label} in the compiled module forces a blocking "
+                    "host sync every step. HLO: " + line[:160]
+                ),
+            ))
+    return findings
+
+
+def _scalar_findings(args):
+    import jax
+
+    findings = []
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(args)
+    for path, leaf in leaves_with_path:
+        if isinstance(leaf, bool) or not isinstance(leaf, (int, float)):
+            continue
+        key = jax.tree_util.keystr(path) or "<arg>"
+        findings.append(Finding(
+            rule_id=_RULE,
+            severity=Severity.WARNING,
+            op=type(leaf).__name__,
+            location="",
+            message=(
+                f"argument {key} is a Python {type(leaf).__name__}: it "
+                "enters the step weak-typed (promotion can drift with "
+                "the other operand's dtype) and a type change retraces "
+                "the whole program. Pass a 0-d numpy/jnp array with an "
+                "explicit dtype instead."
+            ),
+        ))
+    return findings

@@ -1,0 +1,208 @@
+"""Launcher pre-flight lint: catch ERROR-class graph bugs on the
+driver before ``HorovodRunner`` spawns a single worker.
+
+Opt-in (``SPARKDL_TPU_PREFLIGHT_LINT=1``) so the locked ``run``
+signature and default launch latency are untouched. When enabled, the
+launcher calls :func:`maybe_preflight` with the exact ``(main,
+kwargs)`` it is about to cloudpickle; the hook
+
+1. lints the kwargs payload pytree for 64-bit leaves (the
+   silent-canonicalization bug class needs no tracing to catch at the
+   boundary — the payload is what gets fed to the jitted step);
+2. lints ``main`` itself for pickling-contract violations the AST rule
+   can only guess at: closure/global captures of live
+   ``SparkContext``/``SparkSession`` objects (unpicklable → the gang
+   dies at deserialization) and of device-resident jax arrays (the
+   buffers ride the pickle to every rank);
+3. runs the full graph-pass suite over every artifact registered via
+   :func:`register` — the user's jitted/lowered train step, registered
+   driver-side and therefore never pickled:
+
+   >>> from sparkdl_tpu import analysis
+   >>> analysis.register_preflight(step.lower(params, opt_state, batch))
+   >>> HorovodRunner(np=8).run(main)
+
+WARNING/INFO findings are logged; any ERROR finding raises
+:class:`PreflightLintError` *before* worker spawn, slot claims, or
+payload serialization.
+"""
+
+import logging
+import os
+
+PREFLIGHT_ENV = "SPARKDL_TPU_PREFLIGHT_LINT"
+
+logger = logging.getLogger("HorovodRunner")
+
+_REGISTERED = []
+
+
+class PreflightLintError(RuntimeError):
+    """ERROR-severity findings in the pre-flight lint; the gang was
+    never launched. ``.findings`` carries the full finding list
+    (most-severe first — WARNINGs ride along for context)."""
+
+    def __init__(self, findings):
+        self.findings = sorted(findings, key=lambda f: -int(f.severity))
+        lines = "\n".join(f"  {f}" for f in self.findings)
+        super().__init__(
+            "pre-flight lint found ERROR-severity problems; refusing "
+            "to launch the gang (unset "
+            f"{PREFLIGHT_ENV} to skip the lint):\n{lines}"
+        )
+
+
+def register(obj, *args, **opts):
+    """Register a driver-side artifact for the pre-flight graph lint:
+    a ``jax.stages.Lowered``/``Compiled``, or a callable plus example
+    args (traced and lowered at pre-flight time). ``opts`` are
+    forwarded to the lint helper (``params=``, ``shardings=``,
+    ``mesh=``...). Linting a ``Lowered`` compiles it for the
+    post-partitioning passes and discards the executable — if your
+    driver will compile the step anyway, register the ``Compiled``
+    (``step.lower(...).compile()``) so the expensive compile runs
+    once."""
+    _REGISTERED.append((obj, args, opts))
+    return obj
+
+
+def clear():
+    """Drop all registered artifacts (test isolation)."""
+    _REGISTERED.clear()
+
+
+def enabled(environ=None):
+    env = os.environ if environ is None else environ
+    return env.get(PREFLIGHT_ENV, "").strip() in ("1", "true", "yes")
+
+
+def _closure_findings(main):
+    """Runtime pickling-contract check on the actual function object:
+    unlike the AST rule (which sees source), this sees the live
+    captures cloudpickle would serialize."""
+    from sparkdl_tpu.analysis.core import Finding, Severity
+
+    findings = []
+
+    def classify(name, value, via):
+        tname = type(value).__name__
+        mod = getattr(type(value), "__module__", "") or ""
+        if tname in ("SparkContext", "SparkSession") and \
+                mod.startswith("pyspark"):
+            return Finding(
+                rule_id="pickle-closure-capture",
+                severity=Severity.ERROR,
+                op=tname,
+                location="",
+                message=(
+                    f"main captures the live {tname} {name!r} via "
+                    f"{via}: SparkContext/SparkSession are not "
+                    "picklable, so every worker dies deserializing "
+                    "the payload. Create Spark handles inside main() "
+                    "on the driver only, never capture them."
+                ),
+            )
+        try:
+            import jax
+
+            if isinstance(value, jax.Array):
+                return Finding(
+                    rule_id="pickle-closure-capture",
+                    severity=Severity.ERROR,
+                    op="jax.Array",
+                    location="",
+                    message=(
+                        f"main captures the device array {name!r} "
+                        f"(shape {getattr(value, 'shape', '?')}) via "
+                        f"{via}: its buffers ride the cloudpickle to "
+                        "every rank and pin the driver's device. "
+                        "Build arrays inside main() from host data."
+                    ),
+                )
+        except Exception:
+            pass
+        return None
+
+    code = getattr(main, "__code__", None)
+    closure = getattr(main, "__closure__", None) or ()
+    freevars = getattr(code, "co_freevars", ()) if code else ()
+    for name, cell in zip(freevars, closure):
+        try:
+            value = cell.cell_contents
+        except ValueError:
+            continue
+        f = classify(name, value, "its closure")
+        if f:
+            findings.append(f)
+    if code is not None:
+        import types
+
+        def global_refs(c):
+            # Globals referenced by main OR any function nested in it
+            # (nested code objects ride co_consts) — a capture inside
+            # a helper def pickles exactly the same way.
+            names = set(c.co_names)
+            for const in c.co_consts:
+                if isinstance(const, types.CodeType):
+                    names |= global_refs(const)
+            return names
+
+        g = getattr(main, "__globals__", {})
+        for name in sorted(global_refs(code)):
+            if name in g:
+                f = classify(name, g[name], "a module global")
+                if f:
+                    findings.append(f)
+    return findings
+
+
+def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
+    """Run the pre-flight lint; returns the findings (possibly empty)
+    or raises :class:`PreflightLintError` on any ERROR. No-op (returns
+    None) unless enabled via env. ``per_rank_kwargs`` (the launcher's
+    rank-private payload list) gets the same payload checks as
+    ``kwargs`` — a 64-bit leaf shipped to one rank canonicalizes just
+    as silently as one shipped to all of them."""
+    if not enabled(environ):
+        return None
+    from sparkdl_tpu.analysis import lint_compiled, lint_fn, lint_lowered
+    from sparkdl_tpu.analysis.core import Severity
+    from sparkdl_tpu.analysis.passes_dtype import payload_findings
+
+    findings = []
+    findings.extend(payload_findings(kwargs, where="run() kwargs"))
+    if per_rank_kwargs is not None:
+        findings.extend(
+            payload_findings(per_rank_kwargs, where="per_rank_kwargs")
+        )
+    findings.extend(_closure_findings(main))
+    for obj, args, opts in list(_REGISTERED):
+        try:
+            if hasattr(obj, "compile"):          # Lowered
+                findings.extend(lint_lowered(obj, **opts))
+            elif hasattr(obj, "as_text") or hasattr(obj, "runtime_executable"):
+                findings.extend(lint_compiled(obj, **opts))
+            elif callable(obj):
+                findings.extend(lint_fn(obj, *args, **opts))
+        except Exception as e:
+            logger.warning(
+                "pre-flight lint could not analyze %r (%s: %s); "
+                "launching anyway", obj, type(e).__name__, e,
+            )
+    errors = [f for f in findings if f.severity >= Severity.ERROR]
+    for f in findings:
+        if f.severity < Severity.ERROR:
+            logger.warning("pre-flight lint: %s", f)
+    if errors:
+        # Full list, not just the errors — the warnings are context
+        # for whoever reads the exception.
+        raise PreflightLintError(findings)
+    if findings:
+        logger.info(
+            "pre-flight lint: %d non-blocking finding(s)", len(findings)
+        )
+    return findings
+
+
+# Public aliases used by the package __init__.
+register_preflight = register

@@ -445,6 +445,16 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     """
     from sparkdl_tpu.horovod.supervisor import RetryPolicy, supervise
 
+    # Opt-in pre-flight lint (SPARKDL_TPU_PREFLIGHT_LINT=1): analyze
+    # the payload and any registered jitted/lowered train step on the
+    # driver and refuse to launch on ERROR findings — BEFORE the
+    # supervisor loop, slot claims, payload serialization, or any
+    # worker spawn. A graph bug is permanent; retrying it under
+    # backoff would burn the whole retry budget on chip-hours.
+    from sparkdl_tpu.analysis.preflight import preflight_lint
+
+    preflight_lint(main, kwargs, per_rank_kwargs=per_rank_kwargs)
+
     return supervise(
         lambda extra_env: _launch_gang_once(
             np, main, kwargs, driver_log_verbosity, per_rank_kwargs,

@@ -134,10 +134,23 @@ class HorovodRunner:
           instead of restarting from step 0.
         - ``SPARKDL_TPU_GANG_BACKOFF_BASE/_FACTOR/_MAX/_JITTER``
           shape the exponential backoff between relaunches.
+        - ``SPARKDL_TPU_PREFLIGHT_LINT=1`` statically lints the
+          payload, ``main``'s live captures, and any train step
+          registered via
+          :func:`sparkdl_tpu.analysis.register_preflight` on the
+          driver; ERROR-severity findings raise
+          :class:`sparkdl_tpu.analysis.PreflightLintError` before any
+          worker process is spawned (see ``docs/analysis.rst``).
         """
         np_arg = self.num_processor
         logger = logging.getLogger("HorovodRunner")
         if np_arg == -1:
+            # Same opt-in pre-flight as the gang path (the local mode
+            # is where users iterate before paying for chips — catch
+            # the graph bug here, not on the pod).
+            from sparkdl_tpu.analysis.preflight import preflight_lint
+
+            preflight_lint(main, kwargs)
             logger.warning(
                 "HorovodRunner is running in local mode (np=-1): main() is "
                 "invoked in the current process with a single worker. Use "

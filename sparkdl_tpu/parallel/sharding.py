@@ -70,6 +70,18 @@ def param_sharding(params, rules, mesh):
                         is_leaf=lambda s: isinstance(s, P))
 
 
+def tp_param_info(params, shardings):
+    """Describe which params the given shardings actually split (mesh
+    axes of size 1 excluded): the input the full-param all-gather
+    analysis pass needs to know what "a full TP parameter" means for
+    THIS program. Returns :class:`sparkdl_tpu.analysis.ParamInfo`
+    entries for every leaf; entries with empty ``sharded_axes`` are
+    replicated."""
+    from sparkdl_tpu.analysis import param_info_from
+
+    return param_info_from(params, shardings)
+
+
 # Megatron-style rules for the transformer models in
 # sparkdl_tpu.models: column-parallel up-projections, row-parallel
 # down-projections, replicated norms.

@@ -93,6 +93,28 @@ def make_train_step(loss_fn, optimizer, *, grad_accum=1, remat=False,
     return accumulated
 
 
+def lower_train_step(step, *example_args, mesh=None):
+    """Version-stable lowered-module access for a (jitted or plain)
+    train step: returns the ``jax.stages.Lowered`` for
+    ``step(*example_args)``, entering ``mesh`` around lowering when
+    given (GSPMD programs lower against the ambient mesh).
+
+    This is the artifact the static-analysis passes consume
+    (:mod:`sparkdl_tpu.analysis`): lower once on the driver, then
+    lint and ``.compile()`` the same object — nothing is traced
+    twice. (Compilation is separate: lint the *Compiled* via
+    ``analysis.lint_compiled`` / ``register_preflight`` when you will
+    compile anyway, so the expensive compile runs once too.)
+    """
+    import contextlib
+
+    from sparkdl_tpu.utils import jax_compat
+
+    ctx = mesh if mesh is not None else contextlib.nullcontext()
+    with ctx:
+        return jax_compat.lower(step, *example_args)
+
+
 def shard_batch(batch, mesh, *, seq_axis=False):
     """Device-put a host batch with (data[, seq]) sharding."""
     from jax.sharding import NamedSharding, PartitionSpec as P

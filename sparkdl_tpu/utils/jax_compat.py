@@ -13,6 +13,12 @@ Every wrapper prefers the stable modern API and falls back to the
 - ``axis_size``: ``jax.lax.axis_size`` → the classic
   ``psum(1, axis)``, a compile-time constant inside traced code
   either way.
+
+Also home to the version-stable lowering/jaxpr accessors the static
+analysis subsystem builds on (``lower``, ``lowered_stablehlo``,
+``compiled_hlo``, ``closed_jaxpr``, ``x64_enabled``) and the runtime
+feature probe ``old_xla_spmd_partitioner()`` that tier-1 tests gate
+on instead of failing against the jax-0.4.x XLA.
 """
 
 
@@ -38,6 +44,76 @@ def axis_size(name):
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(name)
     return jax.lax.psum(1, name)
+
+
+def jax_version():
+    """(major, minor, patch) of the running jax."""
+    import jax
+
+    parts = []
+    for tok in jax.__version__.split(".")[:3]:
+        digits = "".join(ch for ch in tok if ch.isdigit())
+        parts.append(int(digits or 0))
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts)
+
+
+def old_xla_spmd_partitioner():
+    """True when the bundled XLA predates the modern SPMD partitioner
+    (jax < 0.5): it rejects ``PartitionId`` inside SPMD programs
+    ("PartitionId instruction is not supported for SPMD partitioning")
+    and keeps boundary-sized activations gathered where the modern
+    partitioner leaves them sharded. Tier-1 tests that exercise either
+    behavior gate on this instead of failing."""
+    return jax_version() < (0, 5, 0)
+
+
+def x64_enabled():
+    """Whether jax_enable_x64 is on (same spelling both lines)."""
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def lower(fn, *args, **kwargs):
+    """``jax.stages.Lowered`` for ``fn(*args, **kwargs)``: uses the
+    function's own ``.lower`` when it is already jitted, else wraps it
+    in ``jax.jit`` first (stable across both jax lines)."""
+    import jax
+
+    if hasattr(fn, "lower"):
+        return fn.lower(*args, **kwargs)
+    return jax.jit(fn).lower(*args, **kwargs)
+
+
+def lowered_stablehlo(lowered):
+    """Pre-partitioning StableHLO text of a ``Lowered``."""
+    try:
+        return lowered.as_text(dialect="stablehlo")
+    except TypeError:
+        return lowered.as_text()
+
+
+def compiled_hlo(lowered_or_compiled):
+    """Post-SPMD-partitioning optimized HLO text — where collectives
+    are concrete ops with replica groups. Accepts a ``Lowered`` (which
+    it compiles) or an already-``Compiled``."""
+    obj = lowered_or_compiled
+    if hasattr(obj, "compile"):
+        obj = obj.compile()
+    return obj.as_text()
+
+
+def closed_jaxpr(fn, *args, **kwargs):
+    """ClosedJaxpr of ``fn(*args, **kwargs)``. A jitted callable
+    yields one pjit eqn wrapping the body — the analysis walker
+    recurses through it, so no unwrapping (unwrapping a shard_map'd
+    fn would trace its body outside the mesh and die on unbound axis
+    names)."""
+    import jax
+
+    return jax.make_jaxpr(fn)(*args, **kwargs)
 
 
 def tpu_compiler_params(**kwargs):

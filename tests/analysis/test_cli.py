@@ -1,0 +1,70 @@
+"""CLI contract: exit codes CI gates on, output formats, argument
+validation. In-process (main() returns the exit status) — no
+subprocess jax imports in the tier-1 box."""
+
+import json
+
+import pytest
+
+from sparkdl_tpu.analysis.__main__ import main
+from tests.analysis.test_selflint import CLEAN, VIOLATION_SPARK
+
+
+@pytest.fixture()
+def bad_file(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(VIOLATION_SPARK)
+    return p
+
+
+@pytest.fixture()
+def clean_file(tmp_path):
+    p = tmp_path / "ok.py"
+    p.write_text(CLEAN)
+    return p
+
+
+def test_error_finding_exits_nonzero(bad_file, capsys):
+    assert main([str(bad_file)]) == 1
+    out = capsys.readouterr().out
+    assert "pickle-closure-capture" in out
+    assert "1 error(s)" in out
+
+
+def test_clean_file_exits_zero(clean_file, capsys):
+    assert main([str(clean_file)]) == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+def test_json_format(bad_file, capsys):
+    assert main([str(bad_file), "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data[0]["rule_id"] == "pickle-closure-capture"
+    assert data[0]["severity"] == "ERROR"
+
+
+def test_fail_on_never(bad_file, capsys):
+    assert main([str(bad_file), "--fail-on", "never"]) == 0
+
+
+def test_directory_target(bad_file, clean_file, capsys):
+    assert main([str(bad_file.parent)]) == 1
+
+
+def test_self_lint_is_clean(capsys):
+    """CI's `--self` gate: the repo lints itself clean."""
+    assert main(["--self"]) == 0
+
+
+def test_no_targets_is_usage_error():
+    with pytest.raises(SystemExit) as e:
+        main([])
+    assert e.value.code == 2
+
+
+def test_list_passes(capsys):
+    assert main(["--list-passes"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("collective-consistency", "full-param-allgather",
+                 "silent-canonicalization", "host-sync-in-step"):
+        assert rule in out

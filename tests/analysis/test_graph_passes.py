@@ -1,0 +1,406 @@
+"""Known-bad graph corpus: one minimal reproducer per analysis pass
+(each asserting rule id + severity), plus the clean-model negative —
+the full pass suite must stay SILENT on the repo's own mnist_cnn train
+step (acceptance bar: a linter that cries wolf on the canonical clean
+model is worse than no linter)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkdl_tpu.analysis import (
+    Severity,
+    lint_fn,
+    lint_gang,
+    param_info_from,
+    run_passes,
+)
+from sparkdl_tpu.analysis.core import GraphContext
+from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+from sparkdl_tpu.utils.jax_compat import shard_map
+
+
+def by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+@pytest.fixture(scope="module")
+def mesh_8():
+    return make_mesh(MeshSpec(data=8))
+
+
+@pytest.fixture(scope="module")
+def mesh_2x4():
+    return make_mesh(MeshSpec(data=2, model=4))
+
+
+# ---------------------------------------------------------------------------
+# collective-consistency
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveConsistency:
+    def test_cond_branch_divergence_deadlock(self, mesh_8):
+        """The minimal gang deadlock: a collective in ONE branch of a
+        data-dependent cond — ranks whose predicate disagrees enter
+        different collectives and hang forever."""
+
+        def inner(x):
+            return jax.lax.cond(
+                x.sum() > 0,
+                lambda v: jax.lax.psum(v, "data"),
+                lambda v: v * 2.0,
+                x,
+            )
+
+        sm = shard_map(inner, mesh_8, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+        findings = by_rule(
+            lint_fn(sm, jnp.ones((8, 4)), compile=False, mesh=mesh_8),
+            "collective-consistency",
+        )
+        assert findings, "deadlocking cond not flagged"
+        assert findings[0].severity == Severity.ERROR
+        assert findings[0].op == "cond"
+        assert "deadlock" in findings[0].message
+
+    def test_matching_branches_are_clean(self, mesh_8):
+        """Both branches issuing the SAME collective sequence is the
+        sanctioned pattern — no finding."""
+
+        def inner(x):
+            return jax.lax.cond(
+                x.sum() > 0,
+                lambda v: jax.lax.psum(v, "data"),
+                lambda v: jax.lax.psum(v * 2.0, "data"),
+                x,
+            )
+
+        sm = shard_map(inner, mesh_8, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+        assert not by_rule(
+            lint_fn(sm, jnp.ones((8, 4)), compile=False, mesh=mesh_8),
+            "collective-consistency",
+        )
+
+    def test_while_loop_collective_warns(self, mesh_8):
+        """A collective under a dynamic trip count is a deadlock
+        hazard (scan is the safe spelling) — WARNING, not ERROR,
+        because a replicated predicate is legal."""
+
+        def inner(x):
+            def body(c):
+                i, v = c
+                return i + 1, jax.lax.psum(v, "data")
+
+            return jax.lax.while_loop(
+                lambda c: c[0] < 3, body, (0, x))[1]
+
+        sm = shard_map(inner, mesh_8, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+        findings = by_rule(
+            lint_fn(sm, jnp.ones((8, 4)), compile=False, mesh=mesh_8),
+            "collective-consistency",
+        )
+        assert findings and findings[0].severity == Severity.WARNING
+        assert findings[0].op == "while"
+
+    def test_scan_collective_is_clean(self, mesh_8):
+        """lax.scan has a static trip count — the ring-attention
+        pattern (ppermute under scan) must NOT be flagged."""
+
+        def inner(x):
+            def body(carry, _):
+                carry = jax.lax.ppermute(
+                    carry, "data",
+                    [(i, (i + 1) % 8) for i in range(8)])
+                return carry, None
+
+            out, _ = jax.lax.scan(body, x, None, length=4)
+            return out
+
+        sm = shard_map(inner, mesh_8, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+        assert not by_rule(
+            lint_fn(sm, jnp.ones((8, 4)), compile=False, mesh=mesh_8),
+            "collective-consistency",
+        )
+
+    def test_cross_rank_order_divergence(self, mesh_8):
+        """Deadlocking collective ORDER across ranks: rank A psums
+        then gathers, rank B gathers then psums — lint_gang flags the
+        first diverging position."""
+
+        def rank_a(x):
+            y = jax.lax.psum(x, "data")
+            return jax.lax.all_gather(y, "data")
+
+        def rank_b(x):
+            y = jax.lax.all_gather(x, "data")
+            return jax.lax.psum(y, "data")
+
+        sm_a = shard_map(rank_a, mesh_8, in_specs=P("data"),
+                         out_specs=P(None, "data"), check_vma=False)
+        sm_b = shard_map(rank_b, mesh_8, in_specs=P("data"),
+                         out_specs=P(None, "data"), check_vma=False)
+        x = jnp.ones((8, 4))
+        with mesh_8:
+            findings = lint_gang([sm_a, sm_b],
+                                 args_per_rank=[(x,), (x,)])
+        assert findings
+        assert findings[0].rule_id == "collective-consistency"
+        assert findings[0].severity == Severity.ERROR
+        assert "diverge" in findings[0].message
+
+    def test_cross_rank_same_program_clean(self, mesh_8):
+        def rank(x):
+            return jax.lax.psum(x, "data")
+
+        sm = shard_map(rank, mesh_8, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+        x = jnp.ones((8, 4))
+        with mesh_8:
+            assert not lint_gang([sm, sm], args_per_rank=[(x,), (x,)])
+
+
+# ---------------------------------------------------------------------------
+# full-param-allgather
+# ---------------------------------------------------------------------------
+
+
+def _tp_setup(mesh):
+    shardings = {"w": NamedSharding(mesh, P(None, "model"))}
+    params = {
+        "w": jax.device_put(jnp.ones((16, 64), jnp.float32),
+                            shardings["w"])
+    }
+    x = jax.device_put(jnp.ones((8, 16), jnp.float32),
+                       NamedSharding(mesh, P("data", None)))
+    return params, shardings, x
+
+
+class TestFullParamAllgather:
+    def test_full_param_gather_flagged(self, mesh_2x4):
+        """Minimal reproducer: a constraint replicating the TP-sharded
+        weight makes XLA all-gather its FULL shape — ERROR naming the
+        param."""
+        params, shardings, x = _tp_setup(mesh_2x4)
+
+        def bad(p, xb):
+            wfull = jax.lax.with_sharding_constraint(
+                p["w"], NamedSharding(mesh_2x4, P()))
+            return (xb @ wfull).sum()
+
+        findings = by_rule(
+            lint_fn(bad, params, x, mesh=mesh_2x4, params=params,
+                    shardings=shardings),
+            "full-param-allgather",
+        )
+        errors = [f for f in findings if f.severity == Severity.ERROR]
+        assert errors, "full-param all-gather not flagged"
+        assert "'w'" in errors[0].message
+        assert errors[0].op == "all-gather"
+
+    def test_sharded_matmul_clean(self, mesh_2x4):
+        """The Megatron pattern — activations flow, weights stay put —
+        must not be flagged."""
+        params, shardings, x = _tp_setup(mesh_2x4)
+
+        def good(p, xb):
+            y = xb @ p["w"]
+            return jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh_2x4, P("data", "model"))).sum()
+
+        findings = by_rule(
+            lint_fn(good, params, x, mesh=mesh_2x4, params=params,
+                    shardings=shardings),
+            "full-param-allgather",
+        )
+        assert not [f for f in findings
+                    if f.severity >= Severity.WARNING], findings
+
+
+# ---------------------------------------------------------------------------
+# silent-canonicalization
+# ---------------------------------------------------------------------------
+
+
+class TestSilentCanonicalization:
+    def test_f64_argument_flagged(self):
+        """The PR 1 bug class at the jit boundary: a float64 array
+        argument is silently canonicalized to f32 (rounding every
+        integer above 2**24)."""
+
+        findings = by_rule(
+            lint_fn(lambda x: x * 2, np.arange(4, dtype=np.float64),
+                    compile=False),
+            "silent-canonicalization",
+        )
+        errors = [f for f in findings if f.severity == Severity.ERROR]
+        assert errors, "f64 argument not flagged"
+        assert errors[0].op == "float64"
+        assert "2**24" in errors[0].message
+
+    def test_f64_literal_inside_step_flagged(self):
+        """An np.float64 literal INSIDE the step: invisible in the
+        canonicalized jaxpr, caught by the x64 shadow trace."""
+
+        def step(x):
+            return x * np.float64(0.5)
+
+        findings = by_rule(
+            lint_fn(step, jnp.ones((4,), jnp.float32), compile=False),
+            "silent-canonicalization",
+        )
+        shadow = [f for f in findings if "computes as float64" in f.message]
+        assert shadow, findings
+        assert shadow[0].severity == Severity.WARNING
+
+    def test_f32_program_clean(self):
+        findings = by_rule(
+            lint_fn(lambda x: x * 2.0, jnp.ones((4,), jnp.float32),
+                    compile=False),
+            "silent-canonicalization",
+        )
+        assert not findings, findings
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-step
+# ---------------------------------------------------------------------------
+
+
+class TestHostSyncInStep:
+    def test_pure_callback_flagged(self):
+        def step(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct((4,), jnp.float32), x)
+            return y * 2
+
+        findings = by_rule(
+            lint_fn(step, jnp.ones((4,), jnp.float32), compile=False),
+            "host-sync-in-step",
+        )
+        errors = [f for f in findings if f.severity == Severity.ERROR]
+        assert errors, "pure_callback not flagged"
+        assert "pure_callback" in errors[0].op
+
+    def test_debug_print_flagged(self):
+        def step(x):
+            jax.debug.print("loss={l}", l=x.sum())
+            return x * 2
+
+        findings = by_rule(
+            lint_fn(step, jnp.ones((4,), jnp.float32), compile=False),
+            "host-sync-in-step",
+        )
+        assert [f for f in findings if f.severity == Severity.ERROR], (
+            "debug.print (a host callback) not flagged"
+        )
+
+    def test_python_scalar_arg_warns(self):
+        findings = by_rule(
+            lint_fn(lambda x, lr: x * lr,
+                    jnp.ones((4,), jnp.float32), 0.1, compile=False),
+            "host-sync-in-step",
+        )
+        warns = [f for f in findings if f.severity == Severity.WARNING]
+        assert warns and "weak-typed" in warns[0].message
+
+    def test_callback_found_in_hlo_when_no_jaxpr(self):
+        """A Lowered registered without its python callable still gets
+        the HLO-level scan (custom-call target match)."""
+        from sparkdl_tpu.analysis import lint_lowered
+
+        def step(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x
+
+        lowered = jax.jit(step).lower(jnp.ones((4,)))
+        findings = by_rule(
+            lint_lowered(lowered), "host-sync-in-step")
+        assert [f for f in findings if f.severity == Severity.ERROR]
+
+    def test_scalar_warning_does_not_mask_hlo_callback(self):
+        """Regression: a Python-scalar WARNING must not suppress the
+        HLO-level callback scan when no jaxpr is available."""
+        from sparkdl_tpu.analysis.core import GraphContext
+        from sparkdl_tpu.analysis.passes_host import host_sync_in_step
+
+        def step(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x
+
+        hlo = jax.jit(step).lower(jnp.ones((4,))).compile().as_text()
+        ctx = GraphContext(hlo_text=hlo, example_args=(3.0,))
+        findings = host_sync_in_step(ctx)
+        assert [f for f in findings if f.severity == Severity.ERROR], (
+            findings
+        )
+
+
+# ---------------------------------------------------------------------------
+# the clean-model negative: every pass, zero findings
+# ---------------------------------------------------------------------------
+
+
+def test_clean_mnist_train_step_is_silent():
+    """The full pass suite over the repo's canonical clean model
+    (models/mnist_cnn.py + the stock train-step factory + the stock
+    loss): not a single finding at any severity."""
+    import optax
+
+    from sparkdl_tpu.models.mnist_cnn import MnistCNN
+    from sparkdl_tpu.parallel.train import (
+        cross_entropy_loss,
+        make_train_step,
+    )
+
+    model = MnistCNN()
+    x = jnp.ones((2, 28, 28, 1), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return cross_entropy_loss(
+            logits[:, None, :], batch["y"][:, None])
+
+    step = make_train_step(loss_fn, opt)
+    batch = {"x": x, "y": jnp.zeros((2,), jnp.int32)}
+    findings = lint_fn(step, params, opt_state, batch, compile=True)
+    assert findings == [], "\n".join(map(str, findings))
+
+
+def test_passes_degrade_on_empty_context():
+    """A context with nothing in it runs no passes and crashes
+    nothing — the preflight path on un-lintable payloads."""
+    assert run_passes(GraphContext()) == []
+
+
+def test_lint_gang_empty_is_empty():
+    assert lint_gang([]) == []
+
+
+def test_param_info_accepts_bare_partition_specs():
+    """'PartitionSpec-like' shardings (no mesh attached) must count
+    named axes as sharded — not silently degrade to replicated, which
+    would make the all-gather pass vacuously green."""
+    info = param_info_from(
+        {"w": jnp.ones((4, 8))}, {"w": P(None, "model")})
+    assert info[0].sharded_axes == ("model",)
+
+
+def test_param_info_ignores_size_one_axes():
+    """A spec axis of mesh size 1 is not 'sharded' (XLA normalizes it
+    away) — param_info must agree or the all-gather pass would invent
+    TP params on single-chip meshes."""
+    mesh = make_mesh(MeshSpec(data=8, model=1))
+    sh = {"w": NamedSharding(mesh, P(None, "model"))}
+    pr = {"w": jnp.ones((4, 4))}
+    (info,) = param_info_from(pr, sh)
+    assert info.sharded_axes == ()

@@ -1,0 +1,174 @@
+"""Launcher pre-flight lint: inert by default, and when enabled it
+surfaces ERROR findings on the driver BEFORE any worker process is
+spawned (asserted with a Popen tripwire in the real launcher path)."""
+
+import numpy as np
+import pytest
+
+import sparkdl_tpu.horovod.launcher as launcher_mod
+from sparkdl_tpu import HorovodRunner
+from sparkdl_tpu.analysis import PREFLIGHT_ENV, PreflightLintError
+from sparkdl_tpu.analysis import preflight as preflight_mod
+from sparkdl_tpu.analysis.preflight import preflight_lint
+
+ENV_ON = {PREFLIGHT_ENV: "1"}
+
+
+def _nested_table():
+    # Lazily-built module-level device array for the nested-capture
+    # regression test (module import must stay jax-init-free).
+    global _NESTED_TABLE
+    try:
+        return _NESTED_TABLE
+    except NameError:
+        import jax.numpy as jnp
+
+        _NESTED_TABLE = jnp.zeros((4,))
+        return _NESTED_TABLE
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    preflight_mod.clear()
+    yield
+    preflight_mod.clear()
+
+
+def _noop_main(**kwargs):
+    return 0
+
+
+class TestHookUnit:
+    def test_inert_without_env(self):
+        # f64 payload would be an ERROR — but the lint is opt-in.
+        assert preflight_lint(
+            _noop_main, {"x": np.zeros(4, np.float64)}, environ={}
+        ) is None
+
+    def test_f64_payload_raises(self):
+        with pytest.raises(PreflightLintError) as e:
+            preflight_lint(
+                _noop_main, {"x": np.zeros(4, np.float64)},
+                environ=ENV_ON,
+            )
+        (f,) = e.value.findings
+        assert f.rule_id == "silent-canonicalization"
+
+    def test_clean_payload_passes(self):
+        assert preflight_lint(
+            _noop_main, {"x": np.zeros(4, np.float32)}, environ=ENV_ON
+        ) == []
+
+    def test_captured_device_array_raises(self):
+        import jax.numpy as jnp
+
+        table = jnp.zeros((8,))
+
+        def main(**kwargs):
+            return float(table.sum())
+
+        with pytest.raises(PreflightLintError) as e:
+            preflight_lint(main, {}, environ=ENV_ON)
+        assert e.value.findings[0].rule_id == "pickle-closure-capture"
+        assert e.value.findings[0].op == "jax.Array"
+
+    def test_registered_step_graph_linted(self):
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        preflight_mod.register(jax.jit(step).lower(jnp.ones((4,))))
+        with pytest.raises(PreflightLintError) as e:
+            preflight_lint(_noop_main, {}, environ=ENV_ON)
+        assert e.value.findings[0].rule_id == "host-sync-in-step"
+
+    def test_unanalyzable_registered_artifact_never_blocks(self):
+        # The lint must not turn its own crash into a launch failure.
+        preflight_mod.register(lambda: 1 / 0)
+        assert preflight_lint(_noop_main, {}, environ=ENV_ON) == []
+
+    def test_per_rank_payload_linted(self):
+        """Rank-private payloads canonicalize just as silently as the
+        shared kwargs — they get the same 64-bit check."""
+        with pytest.raises(PreflightLintError) as e:
+            preflight_lint(
+                _noop_main, {},
+                per_rank_kwargs=[{"shard": np.zeros(2, np.float64)},
+                                 {"shard": np.zeros(2, np.float32)}],
+                environ=ENV_ON,
+            )
+        (f,) = e.value.findings
+        assert f.rule_id == "silent-canonicalization"
+        assert "per_rank_kwargs" in f.message
+
+    def test_capture_inside_nested_function_caught(self):
+        """Regression: a module-global device array referenced only by
+        a helper def'd INSIDE main pickles identically — the walk must
+        see through nested code objects."""
+        _nested_table()
+
+        def main(**kwargs):
+            def helper():
+                return float(_NESTED_TABLE.sum())
+
+            return helper()
+
+        with pytest.raises(PreflightLintError) as e:
+            preflight_lint(main, {}, environ=ENV_ON)
+        assert e.value.findings[0].op == "jax.Array"
+
+
+class _WorkerSpawned(Exception):
+    """Tripwire: the launcher reached subprocess.Popen."""
+
+
+@pytest.fixture()
+def popen_tripwire(monkeypatch):
+    def boom(*a, **k):
+        raise _WorkerSpawned(a[0] if a else "?")
+
+    monkeypatch.setattr(launcher_mod.subprocess, "Popen", boom)
+
+
+class TestLauncherWiring:
+    """The acceptance assertions: through the REAL gang-launch path
+    (HorovodRunner.run -> launch_gang), with worker spawn replaced by
+    a tripwire so no actual gang ever starts."""
+
+    def test_error_findings_block_before_any_worker_spawn(
+            self, popen_tripwire, monkeypatch):
+        monkeypatch.setenv(PREFLIGHT_ENV, "1")
+        with pytest.raises(PreflightLintError):
+            # If the lint ran late this would raise _WorkerSpawned.
+            HorovodRunner(np=-2).run(
+                _noop_main, sizes=np.zeros(4, np.float64))
+
+    def test_lint_off_by_default_reaches_spawn(self, popen_tripwire,
+                                               monkeypatch):
+        monkeypatch.delenv(PREFLIGHT_ENV, raising=False)
+        monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "0")
+        # Same bad payload, lint not enabled: launch proceeds all the
+        # way to worker spawn (the tripwire) — proving the hook is
+        # inert by default.
+        with pytest.raises(Exception) as e:
+            HorovodRunner(np=-2).run(
+                _noop_main, sizes=np.zeros(4, np.float64))
+        assert not isinstance(e.value, PreflightLintError)
+
+    def test_clean_payload_with_lint_on_reaches_spawn(
+            self, popen_tripwire, monkeypatch):
+        monkeypatch.setenv(PREFLIGHT_ENV, "1")
+        monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "0")
+        with pytest.raises(Exception) as e:
+            HorovodRunner(np=-2).run(
+                _noop_main, sizes=np.zeros(4, np.float32))
+        assert not isinstance(e.value, PreflightLintError)
+
+    def test_local_inprocess_mode_also_linted(self, monkeypatch):
+        monkeypatch.setenv(PREFLIGHT_ENV, "1")
+        with pytest.raises(PreflightLintError):
+            HorovodRunner(np=-1).run(
+                _noop_main, sizes=np.zeros(4, np.float64))

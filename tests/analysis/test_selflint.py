@@ -1,0 +1,120 @@
+"""AST pickling-contract rule: violation and clean cases, plus the
+repo's own surface staying clean (the CI self-lint gate)."""
+
+import textwrap
+
+from sparkdl_tpu.analysis import Severity
+from sparkdl_tpu.analysis.selflint import (
+    RULE_ID,
+    lint_paths,
+    lint_source,
+    self_targets,
+)
+
+VIOLATION_SPARK = textwrap.dedent("""
+    from pyspark.sql import SparkSession
+    from sparkdl_tpu import HorovodRunner
+
+    spark = SparkSession.builder.appName("x").getOrCreate()
+
+    def main():
+        return spark.read.parquet("/data").count()
+
+    HorovodRunner(np=4).run(main)
+""")
+
+VIOLATION_JAX_ARRAY = textwrap.dedent("""
+    import jax.numpy as jnp
+    from sparkdl_tpu import HorovodRunner
+
+    table = jnp.zeros((1024, 1024))
+
+    def main():
+        return float((table * 2).sum())
+
+    runner = HorovodRunner(np=2)
+    runner.run(main)
+""")
+
+CLEAN = textwrap.dedent("""
+    from sparkdl_tpu import HorovodRunner
+
+    def main():
+        from pyspark.sql import SparkSession
+        import jax.numpy as jnp
+        spark = SparkSession.builder.getOrCreate()
+        table = jnp.zeros((4,))
+        return float(table.sum())
+
+    HorovodRunner(np=2).run(main)
+""")
+
+# A module-level Spark handle that exists but is NOT reachable from
+# the main passed to run() — must not be flagged (precision, not just
+# recall).
+CLEAN_UNREACHABLE = textwrap.dedent("""
+    from pyspark.sql import SparkSession
+    from sparkdl_tpu import HorovodRunner
+
+    spark = SparkSession.builder.getOrCreate()
+
+    def report():
+        return spark.version
+
+    def main():
+        return 42
+
+    HorovodRunner(np=2).run(main)
+""")
+
+
+def test_spark_capture_flagged():
+    findings = lint_source(VIOLATION_SPARK, "viol.py")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule_id == RULE_ID
+    assert f.severity == Severity.ERROR
+    assert f.op == "spark"
+    assert "not picklable" in f.message
+
+
+def test_module_level_jax_array_capture_flagged():
+    """Runner held in a variable, run() called on the variable — the
+    resolution must follow the assignment."""
+    findings = lint_source(VIOLATION_JAX_ARRAY, "viol.py")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == Severity.ERROR
+    assert f.op == "table"
+    assert "device buffers" in f.message
+
+
+def test_clean_module_silent():
+    assert lint_source(CLEAN, "clean.py") == []
+
+
+def test_unreachable_taint_silent():
+    assert lint_source(CLEAN_UNREACHABLE, "clean2.py") == []
+
+
+def test_syntax_error_degrades_to_info():
+    (f,) = lint_source("def broken(:\n", "broken.py")
+    assert f.severity == Severity.INFO
+
+
+def test_lint_paths_over_tmpdir(tmp_path):
+    (tmp_path / "bad.py").write_text(VIOLATION_SPARK)
+    (tmp_path / "ok.py").write_text(CLEAN)
+    findings = lint_paths([tmp_path])
+    assert len(findings) == 1
+    assert findings[0].location.startswith(str(tmp_path / "bad.py"))
+
+
+def test_repo_self_surface_is_clean():
+    """The gate CI enforces: the package, examples/, and the driver
+    entry carry no pickling-contract violations."""
+    findings = [
+        f for f in lint_paths(self_targets())
+        if f.severity >= Severity.ERROR
+    ]
+    assert findings == [], "\n".join(map(str, findings))
