@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""CI telemetry smoke (ISSUE: observability satellite): run an
+instrumented local mnist gang with ``SPARKDL_TPU_TELEMETRY_DIR`` set
+and FAIL the build if the merged timeline/metrics artifacts are
+missing or malformed. The artifacts are uploaded by the workflow so a
+red (or green) run's gang story can be opened in Perfetto straight
+from the build page.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/telemetry_smoke.py``
+(defaults the dir to ``./telemetry-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step.
+"""
+
+import glob
+import json
+import os
+import sys
+
+# Runnable as `python ci/telemetry_smoke.py` from a checkout: the
+# script dir (ci/) is sys.path[0], the package root is one up.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+STEPS = 3
+
+
+def _mnist_gang_main(steps):
+    """A tiny real training gang: flax MnistCNN + optax + gradient
+    allreduce over the collective engine, instrumented end to end."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.models.mnist_cnn import MnistCNN
+    from sparkdl_tpu.parallel.train import instrument_step
+    from sparkdl_tpu.utils.profiler import annotate
+
+    hvd.init()
+    model = MnistCNN()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1), jnp.float32)
+    )["params"]
+    opt = optax.sgd(0.01)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(hvd.rank())
+
+    def loss_fn(p, x, y):
+        logits = model.apply({"params": p}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    def step(params, opt_state, x, y):
+        with annotate("mnist-grad"):
+            loss, grads = grad_fn(params, x, y)
+        grads = jax.tree.map(
+            lambda g: hvd.allreduce(np.asarray(g)), grads)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    stepped = instrument_step(step)
+    for _ in range(steps):
+        x = rng.rand(8, 28, 28, 1).astype("float32")
+        y = rng.randint(0, 10, 8).astype("int32")
+        params, opt_state, loss = stepped(params, opt_state, x, y)
+    return {"rank": hvd.rank(), "size": hvd.size(),
+            "loss": float(loss)}
+
+
+def fail(msg):
+    print(f"TELEMETRY SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "telemetry-artifacts"),
+    )
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+
+    from sparkdl import HorovodRunner
+
+    result = HorovodRunner(np=-2).run(_mnist_gang_main, steps=STEPS)
+    print("gang result:", result)
+    if result["size"] != 2:
+        fail(f"expected a 2-rank gang, got size {result['size']}")
+
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected exactly one run dir under {out_dir}, "
+             f"found {run_dirs}")
+    run = run_dirs[0]
+
+    # timeline.json: valid Chrome trace with step spans from BOTH ranks
+    try:
+        with open(os.path.join(run, "timeline.json")) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"timeline.json missing or malformed: {e}")
+    events = trace.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail("timeline.json has no traceEvents")
+    step_lanes = {e.get("pid") for e in events
+                  if e.get("name") == "train_step" and e.get("ph") == "X"}
+    if not {1, 2} <= step_lanes:
+        fail(f"train_step spans missing from some ranks "
+             f"(lanes seen: {sorted(step_lanes)})")
+    names = {e.get("name") for e in events}
+    for required in ("worker.ready", "gang.rendezvous", "mnist-grad"):
+        if required not in names:
+            fail(f"timeline missing required event {required!r}")
+
+    # metrics.prom: per-rank collective + step series present
+    try:
+        with open(os.path.join(run, "metrics.prom")) as f:
+            prom = f.read()
+    except OSError as e:
+        fail(f"metrics.prom missing: {e}")
+    for needle in (
+        "# TYPE collective_ops_total counter",
+        'collective_ops_total{op="reduce",rank="0"}',
+        'collective_ops_total{op="reduce",rank="1"}',
+        'train_step_seconds_count{phase="execute",rank="0"}',
+        'gang_attempts_total{rank="driver"} 1',
+    ):
+        if needle not in prom:
+            fail(f"metrics.prom missing {needle!r}")
+
+    # metrics.json: parses and names every lane
+    try:
+        with open(os.path.join(run, "metrics.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"metrics.json missing or malformed: {e}")
+    ranks = {s.get("labels", {}).get("rank") for s in doc.get("series", [])}
+    if not {"driver", "0", "1"} <= ranks:
+        fail(f"metrics.json missing rank series (have {sorted(ranks)})")
+
+    print(f"telemetry smoke OK: artifacts under {run}")
+
+
+if __name__ == "__main__":
+    main()

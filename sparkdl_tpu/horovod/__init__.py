@@ -19,6 +19,7 @@ MAX_LOG_MESSAGE_LENGTH = 4000  # reference sparkdl/horovod/__init__.py:23
 RestartContext = collections.namedtuple(
     "RestartContext", ["attempt", "resume_step"]
 )
+_resume_instant_emitted = False  # one gang.resume marker per process
 RestartContext.__doc__ = """The gang supervisor's restart context.
 
 ``attempt``: how many times this gang has been relaunched (0 on the
@@ -53,8 +54,23 @@ def restart_context():
         RESUME_STEP_ENV,
     )
 
+    global _resume_instant_emitted
+
     attempt = int(_os.environ.get(RESTART_ATTEMPT_ENV, "0"))
     step = _os.environ.get(RESUME_STEP_ENV)
+    if attempt > 0 and not _resume_instant_emitted:
+        # The "resumed" beat of the gang timeline: a relaunched worker
+        # reading its restart context is the moment recovery actually
+        # happened (inert unless telemetry is on). Emitted ONCE per
+        # process — mains may legitimately poll restart_context()
+        # every step, and the story must stay one marker, not a wall.
+        _resume_instant_emitted = True
+        from sparkdl_tpu import observe
+
+        observe.instant(
+            "gang.resume", cat="supervisor", attempt=attempt,
+            resume_step=int(step) if step is not None else None,
+        )
     return RestartContext(attempt, int(step) if step is not None else None)
 
 

@@ -95,6 +95,7 @@ def worker_io(rank, local_log_path=None):
     Yields the control-plane client (None outside a job). Exceptions
     propagate to the caller after their traceback has been teed and
     shipped as an EXC frame."""
+    from sparkdl_tpu import observe
     from sparkdl_tpu.horovod.control_plane import get_worker_client
 
     client = get_worker_client()
@@ -103,6 +104,13 @@ def worker_io(rank, local_log_path=None):
         # reaps dead workers; this reaps workers whose DRIVER died
         # (even via SIGKILL) so orphans never pin chips or leases.
         client.start_driver_watchdog()
+    if client is not None and observe.enabled():
+        # Telemetry transport: periodic batched flushes of this
+        # worker's metric snapshot + timeline events over the control
+        # plane (TELEMETRY frames), merged gang-wide on the driver.
+        observe.set_sink(client.send_telemetry)
+        observe.start_flusher()
+        observe.instant("worker.start", cat="worker", rank=rank)
     _set_parent_death_signal()
     local_log = (
         open(local_log_path, "a", buffering=1) if local_log_path
@@ -131,6 +139,16 @@ def worker_io(rank, local_log_path=None):
         # file is about to close, so restore the originals first.
         sys.stdout, sys.stderr = orig_stdout, orig_stderr
         if client is not None:
+            if observe.enabled():
+                # Final flush BEFORE the BYE: the driver treats BYE as
+                # this rank's last word, and the tail of the timeline
+                # (checkpoint saves, the last step spans) must not
+                # die with the process.
+                observe.instant("worker.exit", cat="worker", rank=rank,
+                                exit_code=exit_code)
+                observe.stop_flusher()
+                observe.flush()
+                observe.set_sink(None)
             client.send_bye(exit_code)
             client.close()
         local_log.close()
@@ -186,6 +204,9 @@ def main():
             # reference runner_base.py:54-58).
             if client is not None:
                 client.send_ready()
+            from sparkdl_tpu import observe
+
+            observe.instant("worker.ready", cat="worker", rank=rank)
 
             # 5. Deserialize and run the user main (under a per-rank
             # profiler trace when SPARKDL_TPU_PROFILE is set).

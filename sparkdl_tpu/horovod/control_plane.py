@@ -42,6 +42,7 @@ MSG_BYE = 6
 MSG_AUTH = 7
 MSG_RESULT_PART = 8   # chunk of an oversized RESULT (rank 0 only)
 MSG_RESULT_END = 9    # terminates a chunked RESULT
+MSG_TELEMETRY = 10    # observe: batched metric snapshot + timeline events
 
 _HEADER = struct.Struct(">IBI")  # length (of type+rank+payload), type, rank
 
@@ -51,6 +52,7 @@ _MSG_NAMES = {
     MSG_READY: "READY", MSG_LOG: "LOG", MSG_USERLOG: "USERLOG",
     MSG_RESULT: "RESULT", MSG_EXC: "EXC", MSG_BYE: "BYE",
     MSG_AUTH: "AUTH", MSG_RESULT_PART: "RESULT", MSG_RESULT_END: "RESULT",
+    MSG_TELEMETRY: "TELEMETRY",
 }
 
 CONTROL_ADDR_ENV = "SPARKDL_TPU_CONTROL_ADDR"
@@ -140,9 +142,14 @@ class ControlPlaneServer:
     """
 
     def __init__(self, num_workers, verbosity="log_callback_only", log_path=None,
-                 bind_host="127.0.0.1", advertise_host=None, secret=None):
+                 bind_host="127.0.0.1", advertise_host=None, secret=None,
+                 telemetry=None):
         self.num_workers = num_workers
         self.verbosity = verbosity
+        # Optional observability sink (sparkdl_tpu.observe.aggregate.
+        # GangTelemetry): TELEMETRY frames are decoded and handed to
+        # it; without one they are dropped (telemetry is opt-in).
+        self._telemetry = telemetry
         # Per-job shared secret; the launcher ships it to workers via
         # CONTROL_SECRET_ENV. Auto-generated so no caller can forget it.
         self.secret = secret or _secrets.token_hex(32)
@@ -336,6 +343,14 @@ class ControlPlaneServer:
                         self._result_rank = rank
                     self._result_parts = []
                     self._result_parts_bytes = 0
+        elif mtype == MSG_TELEMETRY:
+            if self._telemetry is not None:
+                # ingest() shape-checks and raises on malformed frames;
+                # the per-frame handler above logs and keeps serving,
+                # so bad telemetry can never poison READY/RESULT/BYE.
+                self._telemetry.ingest(
+                    rank, json.loads(payload.decode("utf-8", "replace"))
+                )
         elif mtype == MSG_EXC:
             msg = json.loads(payload.decode("utf-8", "replace"))
             with self._lock:
@@ -502,6 +517,16 @@ class ControlPlaneClient:
         # (reference contract: the driver prints it,
         # sparkdl/horovod/__init__.py:20-25).
         self._send_json(MSG_USERLOG, {"text": text[:MAX_LOG_TEXT]})
+
+    def send_telemetry(self, payload_obj):
+        # Observability flushes (sparkdl_tpu.observe): low-rate batched
+        # snapshots, so they take the guaranteed control socket like
+        # log_to_driver — never the droppable native ring (a lost
+        # final flush would hide exactly the events a postmortem
+        # needs). Backpressure contract unchanged: the flusher batches
+        # on an interval, so volume stays bounded regardless of how
+        # hot the instrumented paths run.
+        self._send_json(MSG_TELEMETRY, payload_obj)
 
     def send_result(self, pickled_bytes):
         # One frame when it fits; otherwise chunk under the frame cap

@@ -443,6 +443,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
         shard) is shipped only to its worker instead of to the whole
         gang.
     """
+    from sparkdl_tpu import observe
     from sparkdl_tpu.horovod.supervisor import RetryPolicy, supervise
 
     # Opt-in pre-flight lint (SPARKDL_TPU_PREFLIGHT_LINT=1): analyze
@@ -455,19 +456,49 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
 
     preflight_lint(main, kwargs, per_rank_kwargs=per_rank_kwargs)
 
-    return supervise(
-        lambda extra_env: _launch_gang_once(
-            np, main, kwargs, driver_log_verbosity, per_rank_kwargs,
-            extra_env=extra_env,
-        ),
-        RetryPolicy.from_env(),
-    )
+    # Opt-in telemetry (SPARKDL_TPU_TELEMETRY_DIR): ONE aggregator per
+    # launch_gang call spans every supervised attempt, so a chaos run's
+    # kill → classify → backoff → resume lands in one merged timeline.
+    # Artifacts are written in the finally — a gang that exhausts its
+    # retry budget leaves its telemetry behind for the postmortem.
+    telemetry = None
+    if observe.enabled():
+        from sparkdl_tpu.observe.aggregate import GangTelemetry
+
+        telemetry = GangTelemetry()
+    try:
+        return supervise(
+            lambda extra_env: _launch_gang_once(
+                np, main, kwargs, driver_log_verbosity, per_rank_kwargs,
+                extra_env=extra_env, telemetry=telemetry,
+            ),
+            RetryPolicy.from_env(),
+        )
+    finally:
+        # Guard the dir re-read too: the write must NEVER mask the
+        # gang's own result/exception, even if the env vanished
+        # mid-run (tests) or the dir is unwritable.
+        if telemetry is not None and observe.telemetry_dir():
+            try:
+                paths = telemetry.write(observe.new_run_dir())
+            except Exception as e:
+                # Catch-all, deliberately: an unwritable dir OR a
+                # malformed frame that slipped past ingest's shape
+                # check and only detonates in the merge math must
+                # never replace the gang's own result/exception.
+                logger.warning("telemetry write under %s failed: %s",
+                               observe.telemetry_dir(), e)
+            else:
+                logger.info("gang telemetry written: %s",
+                            ", ".join(sorted(paths.values())))
 
 
 def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
-                      per_rank_kwargs=None, extra_env=None):
+                      per_rank_kwargs=None, extra_env=None,
+                      telemetry=None):
     import cloudpickle
 
+    from sparkdl_tpu import observe
     from sparkdl_tpu.horovod.control_plane import ControlPlaneServer
     from sparkdl_tpu.horovod.supervisor import GangFailure
     from sparkdl_tpu.horovod.topology import Placement, is_local_host
@@ -561,18 +592,20 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     # a leaked claim counts as busy for this driver's whole lifetime.
     slot_claim = None
     if mode == "cluster":
-        if spec_placement is not None:
-            n_local = sum(
-                1 for r in range(num_workers)
-                if is_local_host(spec_placement.host(r))
-            )
-            local_total = sum(
-                s for h, s in spec_placement.hosts if is_local_host(h)
-            )
-            if n_local:
-                slot_claim = claim_slots(n_local, local_total)
-        else:
-            slot_claim = claim_slots(num_workers, total_slots)
+        with observe.span("gang.slot_claim", cat="launch",
+                          num_workers=num_workers):
+            if spec_placement is not None:
+                n_local = sum(
+                    1 for r in range(num_workers)
+                    if is_local_host(spec_placement.host(r))
+                )
+                local_total = sum(
+                    s for h, s in spec_placement.hosts if is_local_host(h)
+                )
+                if n_local:
+                    slot_claim = claim_slots(n_local, local_total)
+            else:
+                slot_claim = claim_slots(num_workers, total_slots)
     server = None
     procs = []
     boot_logs = []
@@ -627,6 +660,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             # Remote workers dial back in: bind beyond loopback and
             # advertise a routable address.
             bind_host="0.0.0.0" if remote_hosts else "127.0.0.1",
+            telemetry=telemetry,
         )
         # jax.distributed's coordinator lives in RANK 0, so the
         # rendezvous address must name rank 0's host, reachable from
@@ -664,6 +698,9 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             "Launching HorovodRunner gang: %d worker(s), mode=%s, job_dir=%s",
             num_workers, mode, job_dir,
         )
+        observe.instant("gang.spawn", cat="launch",
+                        num_workers=num_workers, mode=mode,
+                        job_dir=job_dir)
         for r in range(num_workers):
             env = _worker_env(
                 os.environ, rank=r, size=num_workers,
@@ -766,28 +803,35 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         # the full start timeout.
         timeout = float(os.environ.get(START_TIMEOUT_ENV, DEFAULT_START_TIMEOUT))
         deadline = time.monotonic() + timeout
-        while server.ready_count() < num_workers:
-            dead = [
-                (r, p.poll()) for r, p in enumerate(procs)
-                if p.poll() is not None and p.poll() != 0
-            ]
-            if dead:
-                time.sleep(0.5)  # let EXC frames drain
-                _fail(
-                    "HorovodRunner gang failed to start: worker(s) "
-                    f"{[r for r, _ in dead]} exited during rendezvous "
-                    f"(codes {[c for _, c in dead]}). Worker logs: {job_dir}",
-                    [p.poll() or 0 for p in procs], kind="start_failure",
-                )
-            if time.monotonic() > deadline:
-                _fail(
-                    f"HorovodRunner gang failed to start: only "
-                    f"{server.ready_count()}/{num_workers} workers reached "
-                    f"the rendezvous within {timeout:.0f}s (fail-fast, "
-                    f"reference runner_base.py:54-58). Worker logs: {job_dir}",
-                    kind="rendezvous_timeout",
-                )
-            time.sleep(0.05)
+        # The span closes however the loop exits, so an aborted
+        # rendezvous still shows its (partial) duration on the gang
+        # timeline next to the failure instants.
+        with observe.span("gang.rendezvous", cat="launch",
+                          num_workers=num_workers):
+            while server.ready_count() < num_workers:
+                dead = [
+                    (r, p.poll()) for r, p in enumerate(procs)
+                    if p.poll() is not None and p.poll() != 0
+                ]
+                if dead:
+                    time.sleep(0.5)  # let EXC frames drain
+                    _fail(
+                        "HorovodRunner gang failed to start: worker(s) "
+                        f"{[r for r, _ in dead]} exited during rendezvous "
+                        f"(codes {[c for _, c in dead]}). Worker logs: {job_dir}",
+                        [p.poll() or 0 for p in procs], kind="start_failure",
+                    )
+                if time.monotonic() > deadline:
+                    _fail(
+                        f"HorovodRunner gang failed to start: only "
+                        f"{server.ready_count()}/{num_workers} workers reached "
+                        f"the rendezvous within {timeout:.0f}s (fail-fast, "
+                        f"reference runner_base.py:54-58). Worker logs: {job_dir}",
+                        kind="rendezvous_timeout",
+                    )
+                time.sleep(0.05)
+        observe.instant("gang.ready", cat="launch",
+                        num_workers=num_workers)
 
         # Monitor the running gang. If one rank dies while others are
         # blocked in a collective (which has no timeout on ICI), give the

@@ -312,6 +312,8 @@ def supervise(launch, policy, _sleep=time.sleep):
     step); each relaunch ships the incremented attempt number and the
     newest committed checkpoint step.
     """
+    from sparkdl_tpu import observe
+
     attempts = []
     attempt = 1
     while True:
@@ -321,11 +323,23 @@ def supervise(launch, policy, _sleep=time.sleep):
             step = _resume_step(policy)
             if step is not None:
                 extra_env[RESUME_STEP_ENV] = str(step)
+        observe.inc("gang_attempts_total")
+        observe.instant("gang.attempt", cat="supervisor", attempt=attempt)
         try:
             return launch(extra_env)
         except Exception as e:
             verdict, cause = classify_failure(e)
             attempts.append(AttemptRecord(attempt, verdict, cause))
+            # Every AttemptRecord lands on the gang timeline with its
+            # classify_failure verdict — the "classified transient"
+            # beat of a chaos run's story — and in the metric view
+            # (gang_failures_total by verdict, alertable).
+            observe.instant(
+                "gang.failure", cat="supervisor", attempt=attempt,
+                verdict=verdict, cause=cause,
+                kind=getattr(e, "kind", type(e).__name__),
+            )
+            observe.inc("gang_failures_total", verdict=verdict)
             first_line = (str(e).splitlines() or ["<no message>"])[0]
             if verdict == PERMANENT:
                 logger.error(
@@ -353,5 +367,9 @@ def supervise(launch, policy, _sleep=time.sleep):
                 else f" (will resume from step {resume})",
                 first_line,
             )
-            _sleep(delay)
+            observe.inc("gang_restarts_total")
+            with observe.span("gang.backoff", cat="supervisor",
+                              attempt=attempt, delay_s=round(delay, 3),
+                              resume_step=resume):
+                _sleep(delay)
             attempt += 1

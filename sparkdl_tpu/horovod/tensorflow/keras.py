@@ -14,6 +14,7 @@ import time
 
 from tensorflow import keras
 
+from sparkdl_tpu import observe
 from sparkdl_tpu.horovod import log_to_driver
 
 __all__ = ["LogCallback"]
@@ -26,6 +27,39 @@ def _fmt_logs(logs):
         f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
         for k, v in logs.items()
     )
+
+
+def _numeric_logs(logs):
+    out = {}
+    for k, v in (logs or {}).items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue  # non-scalar entries stay log-line-only
+    return out
+
+
+def _emit(scope, logs, **extra):
+    """Mirror Keras progress into the observe layer: each numeric log
+    value becomes a ``keras_<metric>`` gauge (labeled with the hook
+    that produced it) so epoch/batch loss is scrapeable gang-wide, not
+    just readable in the notebook. The log LINES are untouched — this
+    rides next to ``log_to_driver``, never replaces it — the whole
+    emit is a no-op when telemetry is off, and any emit failure is
+    swallowed: metric NAMES here come from user code (a model metric
+    could collide with a registry name of another kind), and telemetry
+    must never take down the training it observes."""
+    if not observe.enabled():
+        return
+    for k, v in _numeric_logs(logs).items():
+        # Guard PER metric: one colliding name (user metric vs an
+        # already-registered kind) must cost one series, not silence
+        # every metric that iterates after it.
+        try:
+            observe.set_gauge(f"keras_{k}", v, scope=scope)
+            observe.inc("keras_metric_updates_total", scope=scope)
+        except Exception:
+            continue
 
 
 class LogCallback(keras.callbacks.Callback):
@@ -47,13 +81,30 @@ class LogCallback(keras.callbacks.Callback):
         self._epoch = epoch
         self._epoch_start = time.time()
         log_to_driver(f"Epoch {epoch} begin at {time.strftime('%Y-%m-%d %H:%M:%S')}")
+        observe.instant("keras.epoch_begin", cat="keras", epoch=epoch)
 
     def on_batch_end(self, batch, logs=None):
         if self.per_batch_log:
             msg = _fmt_logs(logs)
             log_to_driver(f"Epoch {self._epoch} batch {batch}: {msg}")
+        # Batch metrics flow to observe regardless of per_batch_log:
+        # the log-line knob exists because lines are noisy, but gauges
+        # overwrite in place — scrape cost is constant.
+        _emit("batch", logs)
 
     def on_epoch_end(self, epoch, logs=None):
         dt = time.time() - (self._epoch_start or time.time())
         msg = _fmt_logs(logs)
         log_to_driver(f"Epoch {epoch} end ({dt:.1f}s): {msg}")
+        _emit("epoch", logs)
+        if observe.enabled():
+            try:
+                observe.observe_value("keras_epoch_seconds", dt)
+                # User metric names ride NESTED under "metrics": a
+                # metric literally named "epoch" or "seconds" must not
+                # collide with the instant's own keywords.
+                observe.instant("keras.epoch_end", cat="keras",
+                                epoch=epoch, seconds=round(dt, 3),
+                                metrics=_numeric_logs(logs))
+            except Exception:
+                pass

@@ -14,10 +14,13 @@ All functions here take/return numpy arrays; framework adapters live in
 :mod:`sparkdl_tpu.utils.interop`.
 """
 
+import functools
 import threading
+import time
 
 import numpy as np
 
+from sparkdl_tpu import observe
 from sparkdl_tpu.hvd import _state
 
 # Reduction ops (mirror horovod.common.Op semantics)
@@ -33,6 +36,34 @@ from sparkdl_tpu.utils.jax_compat import (
     axis_size as _axis_size,
     shard_map as _shard_map,
 )
+
+
+def _observed(op_name):
+    """Per-collective telemetry: op count, payload bytes, and a
+    wall-time histogram under ``op=<name>`` labels (the engine-level
+    view an allreduce slowdown shows up in first). The hot path pays
+    one cached-boolean check when telemetry is off — the decorator
+    never touches the argument otherwise."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, x, *args, **kwargs):
+            if not observe.enabled():
+                return fn(self, x, *args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(self, x, *args, **kwargs)
+            dt = time.perf_counter() - t0
+            observe.inc("collective_ops_total", op=op_name)
+            observe.inc(
+                "collective_bytes_total",
+                value=int(getattr(x, "nbytes", 0) or 0), op=op_name,
+            )
+            observe.observe_value("collective_seconds", dt, op=op_name)
+            return out
+
+        return wrapper
+
+    return deco
 
 
 def _is_float_dtype(dtype):
@@ -210,6 +241,7 @@ class _CollectiveEngine:
 
     # -- public ops ---------------------------------------------------------
 
+    @_observed("reduce")
     def reduce(self, x_np, op):
         st = _state.state()
         if st.size == 1:
@@ -241,6 +273,7 @@ class _CollectiveEngine:
             out = out.astype(np.bool_)
         return out
 
+    @_observed("reduce_jax")
     def reduce_jax(self, x, op):
         """Allreduce a DEVICE-RESIDENT ``jax.Array`` without any host
         crossing: assembling the global array from the local shard is
@@ -287,6 +320,7 @@ class _CollectiveEngine:
             out = out.astype(jnp.bool_)
         return out
 
+    @_observed("allgather")
     def allgather(self, x_np):
         """Horovod allgather: concatenate along axis 0; ranks may have
         different dim0 (horovod semantics). Implemented as size-exchange
@@ -316,6 +350,7 @@ class _CollectiveEngine:
         parts = [gathered[r, : int(sizes[r])] for r in range(st.size)]
         return np.concatenate(parts, axis=0)
 
+    @_observed("alltoall")
     def alltoall_equal(self, x_np):
         """Equal-split all-to-all: local (n*chunk, ...) in, local
         (n*chunk, ...) out where slot j holds rank j's chunk for us —
@@ -327,6 +362,7 @@ class _CollectiveEngine:
         out = fn(self._to_global(x_np))
         return np.asarray(out.addressable_shards[0].data)[0]
 
+    @_observed("scatter_reduce")
     def scatter_reduce(self, x_np, op):
         """Reduce-scatter along axis 0 (dim0 divisible by size): each
         rank receives its own reduced ``dim0/size`` chunk via ONE
@@ -370,6 +406,7 @@ class _CollectiveEngine:
             out = out.astype(orig_dtype, copy=False)
         return out
 
+    @_observed("broadcast")
     def broadcast(self, x_np, root_rank):
         st = _state.state()
         if st.size == 1:

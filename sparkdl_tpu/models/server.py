@@ -24,6 +24,18 @@ API::
       stream=true  -> text/event-stream, one ``data: {"token": t}``
       event per generated token, then ``data: {"done": ...}``.
     GET /health -> {"status": "ok", "queued": N}
+    GET /metrics -> Prometheus text format (see below)
+
+Observability: the frontend owns a
+:class:`sparkdl_tpu.observe.metrics.Registry` (``self.metrics``) and
+serves it at ``GET /metrics`` — always on, independent of the gang
+telemetry env opt-in, because request metrics are part of a serving
+box's API (a load balancer scrapes them). Instrumented:
+``server_requests_total{code=...}`` (one series per response class —
+200/400/500/503), ``server_queue_depth`` (arrivals waiting for the
+engine thread, sampled at scrape), ``server_request_seconds{code=...}``
+(admission → response), and ``server_first_token_seconds`` (admission
+→ first generated token, the streaming-latency SLO).
 
 Error classification (clients and load balancers must be able to
 tell bad input from a sick server): request-validation failures are
@@ -40,7 +52,10 @@ this completes the serving story: model -> engine -> service.
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from sparkdl_tpu.observe.metrics import Registry
 
 
 def _status_safe(message):
@@ -60,6 +75,8 @@ class _Mailbox:
         self.result = None           # (tokens, finish_reason, logprobs)
         self.error = None
         self.error_code = 500        # set by fail(); 500 = engine fault
+        self.t0 = time.perf_counter()  # admission time (latency metrics)
+        self.first_token_seen = False
 
     def fail(self, code, message):
         """Fail the waiter with an HTTP status that tells the client —
@@ -89,6 +106,10 @@ class ServingFrontend:
 
     def __init__(self, engine, host="127.0.0.1", port=0):
         self.engine = engine
+        # Request metrics, served at GET /metrics. Always live: this
+        # registry is the frontend's own (explicitly constructed), not
+        # the env-gated gang telemetry facade.
+        self.metrics = Registry()
         self._arrivals = queue.Queue()   # (request dict, _Mailbox)
         self._live = {}                  # rid -> _Mailbox
         self._shutdown = threading.Event()
@@ -102,6 +123,21 @@ class ServingFrontend:
                 pass
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    # Sample queue depth at scrape time: the gauge is
+                    # a point-in-time reading by definition, and this
+                    # keeps the hot submit path free of extra work.
+                    frontend.metrics.gauge("server_queue_depth").set(
+                        frontend._arrivals.qsize())
+                    body = frontend.metrics.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path != "/health":
                     self.send_error(404)
                     return
@@ -119,6 +155,8 @@ class ServingFrontend:
                 if self.path != "/generate":
                     self.send_error(404)
                     return
+                t0 = time.perf_counter()   # true arrival, for the
+                #                            400-class latency too
                 # Parse and validate ONCE, synchronously, before any
                 # status line — the streamed and blocking paths must
                 # reject the same inputs with the same 400 (an SSE
@@ -144,6 +182,7 @@ class ServingFrontend:
                             f"({frontend.engine.cfg.max_cache_len})")
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
+                    frontend._record_request(400, t0)
                     self.send_error(400, _status_safe(e))
                     return
                 box = _Mailbox()
@@ -161,42 +200,79 @@ class ServingFrontend:
                     # 503 = lifecycle (see _Mailbox.fail) — clients
                     # and load balancers must be able to tell bad
                     # input from a sick server.
+                    frontend._record_request(box.error_code, box.t0)
                     self.send_error(box.error_code, box.error)
                     return
-                toks, reason, lps = box.result
-                body = json.dumps({
-                    "tokens": [int(t) for t in toks],
-                    "finish_reason": reason,
-                    "logprobs": [float(v) for v in lps],
-                }).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # Count 200 only once the body is DELIVERED — a client
+                # hanging up mid-write records "disconnect", matching
+                # the streaming path's accounting.
+                outcome = "disconnect"
+                try:
+                    toks, reason, lps = box.result
+                    body = json.dumps({
+                        "tokens": [int(t) for t in toks],
+                        "finish_reason": reason,
+                        "logprobs": [float(v) for v in lps],
+                    }).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/json")
+                    self.send_header(
+                        "Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    outcome = 200
+                finally:
+                    frontend._record_request(outcome, box.t0)
 
             def _stream(self, box):
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.end_headers()
-                while True:
-                    tok = box.tokens.get()
-                    if tok is None:              # engine says done
-                        break
+                # SSE commits 200 on the wire up front; the metric
+                # records the request's real OUTCOME class instead —
+                # a 500 that rode a terminal error event counts as
+                # 500, and a client that hung up mid-stream counts as
+                # "disconnect" (the recording rides a finally: a
+                # broken pipe must not silently drop the request from
+                # server_requests_total while its first-token latency
+                # was already observed).
+                outcome = "disconnect"
+                try:
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/event-stream")
+                    self.end_headers()
+                    while True:
+                        tok = box.tokens.get()
+                        if tok is None:          # engine says done
+                            break
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps({"token": tok}).encode()
+                            + b"\n\n")
+                        self.wfile.flush()
+                    if box.error is not None:
+                        tail = {"error": box.error}
+                    else:
+                        tail = {"done": box.result[1]}
                     self.wfile.write(
-                        b"data: " + json.dumps({"token": tok}).encode()
-                        + b"\n\n")
+                        b"data: " + json.dumps(tail).encode() + b"\n\n")
                     self.wfile.flush()
-                if box.error is not None:
-                    tail = {"error": box.error}
-                else:
-                    tail = {"done": box.result[1]}
-                self.wfile.write(
-                    b"data: " + json.dumps(tail).encode() + b"\n\n")
-                self.wfile.flush()
+                    # tail delivered: the stream truly completed
+                    outcome = (box.error_code if box.error is not None
+                               else 200)
+                finally:
+                    frontend._record_request(outcome, box.t0)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address
+
+    def _record_request(self, code, t0):
+        """One response accounted: class counter + latency histogram
+        (labeled by code so p99s aren't polluted by fast 400s)."""
+        code = str(code)
+        self.metrics.counter("server_requests_total", code=code).inc()
+        self.metrics.histogram(
+            "server_request_seconds", code=code
+        ).observe(time.perf_counter() - t0)
 
     # -- engine thread -----------------------------------------------
 
@@ -240,6 +316,11 @@ class ServingFrontend:
         def on_token(rid, tok):
             box = self._live.get(rid)
             if box is not None:
+                if not box.first_token_seen:
+                    box.first_token_seen = True
+                    self.metrics.histogram(
+                        "server_first_token_seconds"
+                    ).observe(time.perf_counter() - box.t0)
                 box.tokens.put(int(tok))
 
         while not self._shutdown.is_set():

@@ -1,0 +1,242 @@
+"""``sparkdl_tpu.observe``: gang-wide structured metrics + a merged
+event timeline riding the control plane.
+
+The package's observability layer (ROADMAP: production scale needs a
+signal you can alert on, not log lines). Three pieces:
+
+- :mod:`~sparkdl_tpu.observe.metrics` — per-process registry of
+  counters/gauges/histograms with Prometheus-text and JSON exporters;
+- :mod:`~sparkdl_tpu.observe.timeline` — typed spans/instants exported
+  as Chrome trace-event JSON (opens in Perfetto);
+- :mod:`~sparkdl_tpu.observe.aggregate` — driver-side merge of worker
+  telemetry into one gang-wide view under ``SPARKDL_TPU_TELEMETRY_DIR``.
+
+This module is the instrumentation facade the rest of the package
+calls. **Off by default**: unless ``SPARKDL_TPU_TELEMETRY_DIR`` is set
+(latched at first use, like the chaos harness), every helper here is a
+no-op behind one cached boolean — production gangs that didn't opt in
+pay a single ``if`` per call site and allocate nothing. The
+:class:`~sparkdl_tpu.observe.metrics.Registry` class itself is always
+live when instantiated explicitly (the serving frontend's ``/metrics``
+endpoint owns one; its request metrics are part of its API, not
+gang telemetry).
+
+Worker→driver transport: inside a gang worker, the worker bootstrap
+registers the control-plane client as the telemetry *sink*
+(:func:`set_sink`) and starts a background flusher
+(:func:`start_flusher`) that ships cumulative metric snapshots plus
+drained timeline events as ``TELEMETRY`` frames every
+``SPARKDL_TPU_TELEMETRY_FLUSH_S`` seconds (default 5) and once more at
+exit — low-rate batches on the guaranteed control socket, same
+backpressure posture as ``log_to_driver``. The chaos harness calls
+:func:`flush` synchronously before an injected kill so the fault
+instant reaches the driver even though the process dies by SIGKILL.
+
+See ``docs/observability.rst`` for the metric catalog and env knobs.
+"""
+
+import itertools
+import os
+import socket
+import threading
+
+from sparkdl_tpu.observe.metrics import Registry
+from sparkdl_tpu.observe.timeline import Timeline
+
+TELEMETRY_DIR_ENV = "SPARKDL_TPU_TELEMETRY_DIR"
+FLUSH_S_ENV = "SPARKDL_TPU_TELEMETRY_FLUSH_S"
+DEFAULT_FLUSH_S = 5.0
+
+__all__ = [
+    "enabled", "telemetry_dir", "metrics", "timeline",
+    "inc", "set_gauge", "observe_value", "span", "instant",
+    "set_sink", "flush", "start_flusher", "stop_flusher",
+    "snapshot_payload", "new_run_dir", "Registry", "Timeline",
+]
+
+# Latched like the chaos harness: gangs ship env at spawn, so one
+# check at first call suffices and the disabled path stays a single
+# boolean test forever after.
+_enabled = None
+
+_registry = Registry()
+_timeline = Timeline()
+_sink = None                       # callable(payload_dict) or None
+_sink_lock = threading.Lock()      # serializes flush() payloads
+_flusher = None
+_flusher_stop = None
+_run_seq = itertools.count()
+
+
+def enabled():
+    """True when telemetry was opted in (``SPARKDL_TPU_TELEMETRY_DIR``
+    set). Cached; tests reset via :func:`_reset_for_tests`."""
+    global _enabled
+    if _enabled is None:
+        _enabled = bool(os.environ.get(TELEMETRY_DIR_ENV))
+    return _enabled
+
+
+def telemetry_dir():
+    return os.environ.get(TELEMETRY_DIR_ENV) or None
+
+
+def new_run_dir():
+    """A fresh per-launch artifact directory under the telemetry root
+    (``run-<driverpid>-<n>``): one gang launch — across all its
+    supervised attempts — writes one merged view."""
+    d = os.path.join(
+        telemetry_dir(), f"run-{os.getpid()}-{next(_run_seq)}"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def metrics():
+    """This process's global registry (driver or worker side)."""
+    return _registry
+
+
+def timeline():
+    """This process's global timeline."""
+    return _timeline
+
+
+# -- recording helpers (no-ops when telemetry is off) -----------------------
+
+
+def inc(name, value=1, **labels):
+    if enabled():
+        _registry.counter(name, **labels).inc(value)
+
+
+def set_gauge(name, value, **labels):
+    if enabled():
+        _registry.gauge(name, **labels).set(value)
+
+
+def observe_value(name, value, buckets=None, **labels):
+    if enabled():
+        _registry.histogram(name, buckets=buckets, **labels).observe(value)
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disabled `span()` path
+    allocates nothing (the zero-overhead contract's visible half)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def span(name, cat="", **args):
+    if not enabled():
+        return _NOOP_SPAN
+    return _timeline.span(name, cat=cat, **args)
+
+
+def instant(name, cat="", **args):
+    if enabled():
+        _timeline.instant(name, cat=cat, **args)
+
+
+# -- worker flush machinery --------------------------------------------------
+
+
+def set_sink(sink):
+    """Register where :func:`flush` ships payloads (a gang worker
+    passes ``client.send_telemetry``); ``None`` unregisters."""
+    global _sink
+    _sink = sink
+
+
+def snapshot_payload():
+    """One flush unit: host/pid attribution, the cumulative metric
+    snapshot, and the timeline events drained since the last flush."""
+    return {
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "metrics": _registry.snapshot(),
+        "events": _timeline.drain(),
+    }
+
+
+def flush(lock_timeout=5.0):
+    """Ship a telemetry payload to the registered sink now. Safe to
+    call from any thread (payload assembly + send are serialized so a
+    periodic flush and a chaos pre-kill flush cannot interleave);
+    no-op without a sink or with telemetry off. The lock acquire is
+    BOUNDED: if another flush is wedged mid-send (driver stopped
+    draining), give up rather than hang — the worker-exit path calls
+    this right after ``stop_flusher``'s join also timed out on that
+    same wedged thread, and BYE must still go out."""
+    sink = _sink
+    if sink is None or not enabled():
+        return False
+    if not _sink_lock.acquire(timeout=lock_timeout):
+        return False
+    try:
+        payload = snapshot_payload()
+        try:
+            sink(payload)
+        except Exception:
+            # Telemetry must never take down the instrumented process;
+            # the control-plane client already swallows socket errors,
+            # this guards custom sinks.
+            return False
+    finally:
+        _sink_lock.release()
+    return True
+
+
+def start_flusher(interval=None):
+    """Background periodic flush (worker side). Idempotent. An
+    interval <= 0 disables the periodic flusher entirely (returns
+    None) — the exit-time and chaos flushes still fire — rather than
+    letting ``wait(0)`` busy-spin TELEMETRY frames at the driver."""
+    global _flusher, _flusher_stop
+    if _flusher is not None and _flusher.is_alive():
+        return _flusher
+    if interval is None:
+        interval = float(os.environ.get(FLUSH_S_ENV, DEFAULT_FLUSH_S))
+    if interval <= 0:
+        return None
+    _flusher_stop = stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            flush()
+
+    _flusher = threading.Thread(
+        target=loop, name="sparkdl-tpu-telemetry-flush", daemon=True
+    )
+    _flusher.start()
+    return _flusher
+
+
+def stop_flusher():
+    global _flusher, _flusher_stop
+    if _flusher_stop is not None:
+        _flusher_stop.set()
+    if _flusher is not None:
+        _flusher.join(timeout=5.0)
+    _flusher = None
+    _flusher_stop = None
+
+
+def _reset_for_tests():
+    """Fresh state: re-latch the enabled flag, empty registry and
+    timeline, no sink/flusher."""
+    global _enabled, _registry, _timeline, _sink
+    stop_flusher()
+    _enabled = None
+    _registry = Registry()
+    _timeline = Timeline()
+    _sink = None

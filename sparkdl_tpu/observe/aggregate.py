@@ -1,0 +1,180 @@
+"""Driver-side gang telemetry aggregation.
+
+Workers flush ``TELEMETRY`` control-plane frames (cumulative metric
+snapshots + drained timeline events, see
+:meth:`sparkdl_tpu.horovod.control_plane.ControlPlaneClient.
+send_telemetry`); the :class:`ControlPlaneServer` hands each decoded
+payload to :meth:`GangTelemetry.ingest`. At the end of a supervised
+launch — success, exhaustion, or permanent failure — the launcher
+calls :meth:`GangTelemetry.write`, which folds in the DRIVER's own
+registry/timeline (supervisor attempts, backoff, slot claims,
+rendezvous) and writes one merged view:
+
+- ``timeline.json`` — Chrome trace-event JSON: lane 0 is the driver,
+  lane ``rank+1`` is each worker rank (labeled with host), so a chaos
+  run reads as one story in Perfetto: kill at step N → classified
+  transient → backoff → resume from checkpoint.
+- ``metrics.prom`` — Prometheus text format, every series labeled
+  ``rank="N"`` (driver series ``rank="driver"``). Counters and
+  histograms sum across a rank's process incarnations (supervised
+  relaunches reset in-process values); gauges take the newest.
+- ``metrics.json`` — the same series as one JSON document.
+
+Ingest is called from control-plane connection threads (one per
+worker) while ``write`` runs on the driver main thread after the gang
+drained — one lock covers both.
+"""
+
+import json
+import os
+import threading
+
+from sparkdl_tpu.observe.metrics import (
+    merge_snapshots,
+    render_json,
+    render_prometheus,
+    snapshot_delta,
+)
+from sparkdl_tpu.observe.timeline import chrome_trace
+
+TIMELINE_FILE = "timeline.json"
+PROM_FILE = "metrics.prom"
+JSON_FILE = "metrics.json"
+
+DRIVER_LABEL = "driver"
+
+
+class GangTelemetry:
+    """Accumulates one gang launch's telemetry (all attempts)."""
+
+    def __init__(self):
+        from sparkdl_tpu import observe
+
+        self._lock = threading.Lock()
+        self._snaps = {}    # (rank, pid) -> latest cumulative snapshot
+        self._events = {}   # rank -> [event, ...]
+        self._hosts = {}    # rank -> host
+        # The driver's global registry outlives launches (a notebook
+        # driver runs many); baseline it NOW so write() reports only
+        # THIS launch's driver-side movement. Worker snapshots need no
+        # baseline — every launch spawns fresh processes.
+        self._driver_base = observe.metrics().snapshot()
+
+    def ingest(self, rank, payload):
+        """Absorb one worker flush (thread-safe; latest snapshot from
+        a given (rank, pid) supersedes its previous one — snapshots
+        are cumulative — while events only ever append)."""
+        rank = int(rank)
+        metrics = payload.get("metrics")
+        if metrics:
+            self._validate_snapshot(metrics)
+        events = payload.get("events") or ()
+        with self._lock:
+            if metrics:
+                self._snaps[(rank, payload.get("pid"))] = metrics
+            if events:
+                self._events.setdefault(rank, []).extend(
+                    e for e in events if isinstance(e, dict)
+                )
+            host = payload.get("host")
+            if host:
+                self._hosts[rank] = str(host)
+
+    @staticmethod
+    def _validate_snapshot(snap):
+        # Frames come off the wire: shape-check EVERYTHING the merge
+        # and render math will touch, before any of it is stored — a
+        # malformed frame must cost one frame (control plane logs and
+        # drops it), never detonate later in write() and cost every
+        # rank's artifacts.
+        num = (int, float)
+        for key in ("counters", "gauges", "histograms"):
+            for s in snap.get(key, ()):
+                if not isinstance(s.get("name"), str) or not isinstance(
+                    s.get("labels", {}), dict
+                ):
+                    raise ValueError(f"malformed metric series: {s!r}")
+                if key != "histograms":
+                    if not isinstance(s.get("value"), num):
+                        raise ValueError(
+                            f"malformed metric series: {s!r}")
+                    continue
+                buckets, counts = s.get("buckets"), s.get("counts")
+                if (
+                    not isinstance(buckets, list)
+                    or not isinstance(counts, list)
+                    or len(counts) != len(buckets) + 1
+                    or not all(isinstance(b, num) for b in buckets)
+                    or not all(isinstance(c, num) for c in counts)
+                    or not isinstance(s.get("sum"), num)
+                    or not isinstance(s.get("count"), num)
+                ):
+                    raise ValueError(f"malformed histogram: {s!r}")
+
+    # -- merged views --------------------------------------------------------
+
+    def _merged(self, driver_snapshot=None):
+        """``[(extra_labels, merged_snapshot), ...]`` — one entry per
+        rank plus the driver's."""
+        with self._lock:
+            by_rank = {}
+            for (rank, _pid), snap in sorted(self._snaps.items()):
+                by_rank.setdefault(rank, []).append(snap)
+        out = []
+        if driver_snapshot is not None:
+            out.append(({"rank": DRIVER_LABEL}, driver_snapshot))
+        for rank in sorted(by_rank):
+            out.append(
+                ({"rank": str(rank)}, merge_snapshots(by_rank[rank]))
+            )
+        return out
+
+    def chrome(self, driver_events=()):
+        with self._lock:
+            ranks = sorted(self._events)
+            groups = [(0, DRIVER_LABEL, list(driver_events))] + [
+                (
+                    rank + 1,
+                    f"rank {rank}"
+                    + (f" @ {self._hosts[rank]}"
+                       if rank in self._hosts else ""),
+                    list(self._events[rank]),
+                )
+                for rank in ranks
+            ]
+        return chrome_trace(groups)
+
+    def write(self, out_dir, driver_registry=None, driver_timeline=None):
+        """Write the merged artifacts. Defaults to the process-global
+        driver registry/timeline (draining the timeline). Writes are
+        atomic (tmp + rename) so a watcher — or the CI artifact check
+        — never reads a half-written file. Returns the paths."""
+        from sparkdl_tpu import observe
+
+        if driver_registry is None:
+            # The baseline only describes the process-global registry;
+            # an explicitly passed registry is the caller's own and is
+            # reported as-is.
+            driver_snap = snapshot_delta(
+                self._driver_base, observe.metrics().snapshot()
+            )
+        else:
+            driver_snap = driver_registry.snapshot()
+        if driver_timeline is None:
+            driver_timeline = observe.timeline()
+        os.makedirs(out_dir, exist_ok=True)
+        labeled = self._merged(driver_snap)
+        trace = self.chrome(driver_timeline.drain())
+        paths = {}
+        for name, text in (
+            (TIMELINE_FILE, json.dumps(trace)),
+            (PROM_FILE, render_prometheus(labeled)),
+            (JSON_FILE, render_json(labeled, indent=2)),
+        ):
+            path = os.path.join(out_dir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+            paths[name] = path
+        return paths

@@ -1,0 +1,391 @@
+"""Per-process metrics registry: counters, gauges, histograms.
+
+Zero-dependency and thread-safe by construction — instrumentation
+points live on gang hot paths (collectives, train steps, the serving
+request loop), so every mutation is one short critical section over
+plain Python numbers, and the registry itself never imports jax,
+numpy, or anything that could initialize a backend.
+
+Export formats:
+
+- :meth:`Registry.to_prometheus` — Prometheus text exposition format
+  (``# TYPE`` headers, ``_bucket``/``_sum``/``_count`` series for
+  histograms), the format the ``ServingFrontend`` ``GET /metrics``
+  endpoint serves and the gang aggregator writes to
+  ``SPARKDL_TPU_TELEMETRY_DIR/metrics.prom``.
+- :meth:`Registry.to_json` — the same data as one JSON document for
+  programmatic consumers (the CI artifact check, dashboards that
+  don't scrape).
+
+Cross-process semantics: workers ship cumulative :meth:`Registry.
+snapshot` dicts to the driver over the control plane; the driver
+merges them per rank with :func:`merge_snapshots` (counters and
+histogram buckets sum across a rank's process incarnations — a
+supervised relaunch restarts the counters — gauges take the newest
+snapshot's value) and renders the gang-wide view with
+:func:`render_prometheus` / :func:`render_json`, one ``rank`` label
+per series.
+"""
+
+import bisect
+import json
+import threading
+import time
+
+# Latency-shaped default buckets (seconds): sub-millisecond collective
+# dispatches through minute-long checkpoint writes.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Counter:
+    """Monotonic counter (Prometheus ``counter``)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, value=1):
+        if value < 0:
+            raise ValueError(f"counters only go up (inc({value}))")
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (Prometheus ``gauge``)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus ``histogram``): one
+    count per upper bound plus the implicit ``+Inf`` catch-all, a
+    running sum, and a total count."""
+
+    __slots__ = ("_lock", "_uppers", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self._lock = threading.Lock()
+        self._uppers = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self._uppers) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        value = float(value)
+        idx = bisect.bisect_left(self._uppers, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def buckets(self):
+        return self._uppers
+
+    @property
+    def counts(self):
+        with self._lock:
+            return list(self._counts)
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+
+def _label_key(labels):
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Registry:
+    """Get-or-create store of named metrics, keyed by (name, labels).
+
+    A name is bound to ONE metric kind; asking for the same name as a
+    different kind raises instead of silently shadowing (the exporter
+    could not render both under one ``# TYPE`` header anyway).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}   # (name, label_key) -> metric object
+        self._kinds = {}     # name -> "counter" | "gauge" | "histogram"
+        self._hist_buckets = {}  # name -> upper bounds (pinned at first use)
+
+    def _get(self, kind, name, labels, factory):
+        key = (name, _label_key(labels))
+        with self._lock:
+            seen = self._kinds.get(name)
+            if seen is not None and seen != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as a {seen}, "
+                    f"cannot re-register as a {kind}"
+                )
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = factory()
+                self._metrics[key] = metric
+                self._kinds[name] = kind
+            return metric
+
+    def counter(self, name, **labels):
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name, **labels):
+        return self._get("gauge", name, labels, Gauge)
+
+    def histogram(self, name, buckets=None, **labels):
+        # Bucket layout is pinned per name so every labeled series of
+        # one histogram aggregates (and renders) on the same bounds.
+        with self._lock:
+            bounds = self._hist_buckets.setdefault(
+                name,
+                tuple(sorted(float(b) for b in buckets))
+                if buckets is not None else DEFAULT_BUCKETS,
+            )
+        return self._get(
+            "histogram", name, labels, lambda: Histogram(bounds)
+        )
+
+    # -- export --------------------------------------------------------------
+
+    def snapshot(self):
+        """Cumulative JSON-able dump of every series — the unit that
+        crosses the control plane (one snapshot supersedes the
+        previous one from the same process)."""
+        with self._lock:
+            items = list(self._metrics.items())
+            kinds = dict(self._kinds)
+        snap = {"ts": time.time(), "counters": [], "gauges": [],
+                "histograms": []}
+        for (name, label_key), metric in items:
+            labels = dict(label_key)
+            kind = kinds[name]
+            if kind == "counter":
+                snap["counters"].append(
+                    {"name": name, "labels": labels, "value": metric.value}
+                )
+            elif kind == "gauge":
+                snap["gauges"].append(
+                    {"name": name, "labels": labels, "value": metric.value}
+                )
+            else:
+                snap["histograms"].append({
+                    "name": name, "labels": labels,
+                    "buckets": list(metric.buckets),
+                    "counts": metric.counts,
+                    "sum": metric.sum, "count": metric.count,
+                })
+        for k in ("counters", "gauges", "histograms"):
+            snap[k].sort(key=lambda s: (s["name"], sorted(s["labels"].items())))
+        return snap
+
+    def to_prometheus(self):
+        return render_prometheus([({}, self.snapshot())])
+
+    def to_json(self, indent=None):
+        return render_json([({}, self.snapshot())], indent=indent)
+
+
+# -- snapshot merging and rendering (driver-side gang view) -----------------
+
+
+def merge_snapshots(snaps):
+    """Merge cumulative snapshots from successive incarnations of ONE
+    logical process (e.g. a rank across supervised relaunches):
+    counters and histogram bucket counts sum, gauges take the value
+    from the newest snapshot (by its ``ts``)."""
+    out = {"ts": 0.0, "counters": [], "gauges": [], "histograms": []}
+    counters = {}
+    gauges = {}   # key -> (ts, value)
+    hists = {}
+    for snap in snaps:
+        ts = snap.get("ts", 0.0)
+        out["ts"] = max(out["ts"], ts)
+        for c in snap.get("counters", ()):
+            key = (c["name"], _label_key(c["labels"]))
+            counters[key] = counters.get(key, 0.0) + c["value"]
+        for g in snap.get("gauges", ()):
+            key = (g["name"], _label_key(g["labels"]))
+            if key not in gauges or ts >= gauges[key][0]:
+                gauges[key] = (ts, g["value"])
+        for h in snap.get("histograms", ()):
+            key = (h["name"], _label_key(h["labels"]))
+            prev = hists.get(key)
+            if prev is None or list(prev["buckets"]) != list(h["buckets"]):
+                # First sight (or a bucket-layout change across a code
+                # rollout mid-job: keep the newer layout rather than
+                # summing incompatible bins).
+                hists[key] = {
+                    "name": h["name"], "labels": dict(h["labels"]),
+                    "buckets": list(h["buckets"]),
+                    "counts": list(h["counts"]),
+                    "sum": h["sum"], "count": h["count"],
+                }
+            else:
+                prev["counts"] = [
+                    a + b for a, b in zip(prev["counts"], h["counts"])
+                ]
+                prev["sum"] += h["sum"]
+                prev["count"] += h["count"]
+    for (name, lk), v in sorted(counters.items()):
+        out["counters"].append(
+            {"name": name, "labels": dict(lk), "value": v})
+    for (name, lk), (_, v) in sorted(gauges.items()):
+        out["gauges"].append({"name": name, "labels": dict(lk), "value": v})
+    for key in sorted(hists):
+        out["histograms"].append(hists[key])
+    return out
+
+
+def snapshot_delta(base, cur):
+    """``cur`` minus ``base`` for the monotonic series — the per-RUN
+    view of a registry that outlives runs (the driver's global
+    registry spans every launch in the process; each launch's
+    artifacts must report only its own counts). Counters subtract by
+    value; histograms subtract bucket counts/sum/count (a bucket-
+    layout change falls back to ``cur``); gauges are point-in-time
+    and pass through. Series that did not move this run are dropped."""
+    out = {"ts": cur.get("ts", 0.0), "counters": [],
+           "gauges": [dict(g) for g in cur.get("gauges", ())],
+           "histograms": []}
+    base_c = {(c["name"], _label_key(c["labels"])): c["value"]
+              for c in base.get("counters", ())}
+    for c in cur.get("counters", ()):
+        v = c["value"] - base_c.get(
+            (c["name"], _label_key(c["labels"])), 0.0)
+        if v > 0:
+            out["counters"].append(
+                {"name": c["name"], "labels": dict(c["labels"]),
+                 "value": v})
+    base_h = {(h["name"], _label_key(h["labels"])): h
+              for h in base.get("histograms", ())}
+    for h in cur.get("histograms", ()):
+        prev = base_h.get((h["name"], _label_key(h["labels"])))
+        if prev is None or list(prev["buckets"]) != list(h["buckets"]):
+            d = {k: (list(h[k]) if isinstance(h[k], list) else h[k])
+                 for k in ("name", "buckets", "counts", "sum", "count")}
+            d["labels"] = dict(h["labels"])
+        else:
+            d = {"name": h["name"], "labels": dict(h["labels"]),
+                 "buckets": list(h["buckets"]),
+                 "counts": [a - b for a, b in
+                            zip(h["counts"], prev["counts"])],
+                 "sum": h["sum"] - prev["sum"],
+                 "count": h["count"] - prev["count"]}
+        if d["count"] > 0:
+            out["histograms"].append(d)
+    return out
+
+
+def _esc(value):
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_str(labels):
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_num(v):
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(labeled_snapshots):
+    """Prometheus text format over ``[(extra_labels, snapshot), ...]``
+    — one ``# TYPE`` header per metric name, every series carrying its
+    own labels plus the extras (the gang aggregator passes
+    ``{"rank": ...}``). Deterministic ordering so exports are
+    golden-testable."""
+    # name -> (kind, [(series_sort_key, [lines in emit order])])
+    # Series sort by their labels; a histogram's bucket lines keep
+    # ascending-``le`` order inside their series (the exposition
+    # format expects cumulative buckets in increasing order).
+    by_name = {}
+    for extra, snap in labeled_snapshots:
+        for kind, key in (("counter", "counters"), ("gauge", "gauges")):
+            for s in snap.get(key, ()):
+                labels = {**s["labels"], **extra}
+                by_name.setdefault(s["name"], (kind, []))[1].append((
+                    _label_key(labels),
+                    [f"{s['name']}{_labels_str(labels)} "
+                     f"{_fmt_num(s['value'])}"],
+                ))
+        for h in snap.get("histograms", ()):
+            labels = {**h["labels"], **extra}
+            lines = []
+            cum = 0
+            for upper, n in zip(h["buckets"], h["counts"]):
+                cum += n
+                lines.append(
+                    f"{h['name']}_bucket"
+                    f"{_labels_str({**labels, 'le': _fmt_num(upper)})} "
+                    f"{cum}"
+                )
+            cum += h["counts"][len(h["buckets"])]
+            lines.append(
+                f"{h['name']}_bucket"
+                f"{_labels_str({**labels, 'le': '+Inf'})} {cum}"
+            )
+            lines.append(
+                f"{h['name']}_sum{_labels_str(labels)} "
+                f"{_fmt_num(h['sum'])}"
+            )
+            lines.append(
+                f"{h['name']}_count{_labels_str(labels)} {h['count']}"
+            )
+            by_name.setdefault(h["name"], ("histogram", []))[1].append(
+                (_label_key(labels), lines)
+            )
+    out = []
+    for name in sorted(by_name):
+        kind, series = by_name[name]
+        out.append(f"# TYPE {name} {kind}")
+        for _, lines in sorted(series, key=lambda s: s[0]):
+            out.extend(lines)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def render_json(labeled_snapshots, indent=None):
+    doc = {
+        "generated_at": time.time(),
+        "series": [
+            {"labels": dict(extra), **snap}
+            for extra, snap in labeled_snapshots
+        ],
+    }
+    return json.dumps(doc, indent=indent, sort_keys=True)

@@ -10,6 +10,7 @@ shardings — no explicit psum, no hand-scheduled overlap.
 """
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -91,6 +92,53 @@ def make_train_step(loss_fn, optimizer, *, grad_accum=1, remat=False,
         return params, opt_state, {"loss": l_sum / grad_accum}
 
     return accumulated
+
+
+def instrument_step(step_fn, name="train_step"):
+    """Wrap a (possibly jitted) train step with gang telemetry
+    (:mod:`sparkdl_tpu.observe`): a timeline span per call, a
+    wall-time histogram split ``phase="compile"`` (first call — under
+    jit that call pays trace + XLA compile) vs ``phase="execute"``,
+    a call counter, and a running ``<name>_per_second`` gauge over the
+    execute calls. Telemetry off (the default): one cached-boolean
+    check, then straight through to ``step_fn``.
+
+    Timing is dispatch wall-time, deliberately: blocking on the result
+    every step would serialize the async dispatch pipeline the whole
+    runner exists to keep full. Steady-state steps/sec is still
+    accurate — a saturated pipeline's dispatch rate IS its device
+    rate — and the compile-vs-execute split isolates the one honest
+    outlier (the first call blocks on XLA anyway).
+    """
+    from sparkdl_tpu import observe
+
+    state = {"calls": 0, "first_exec_t0": None}
+
+    @functools.wraps(step_fn)
+    def stepped(*args, **kwargs):
+        if not observe.enabled():
+            return step_fn(*args, **kwargs)
+        phase = "compile" if state["calls"] == 0 else "execute"
+        t0 = time.perf_counter()
+        with observe.span(name, cat="train", step=state["calls"],
+                          phase=phase):
+            out = step_fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        state["calls"] += 1
+        observe.observe_value(f"{name}_seconds", dt, phase=phase)
+        observe.inc(f"{name}_total", phase=phase)
+        if phase == "execute":
+            if state["first_exec_t0"] is None:
+                state["first_exec_t0"] = t0
+            elapsed = time.perf_counter() - state["first_exec_t0"]
+            if elapsed > 0:
+                observe.set_gauge(
+                    f"{name}_per_second",
+                    (state["calls"] - 1) / elapsed,
+                )
+        return out
+
+    return stepped
 
 
 def lower_train_step(step, *example_args, mesh=None):

@@ -101,8 +101,18 @@ def _claim_once():
     return True
 
 
-def _kill_self():
+def _kill_self(phase="step", step=None):
     sig = int(os.environ.get(KILL_SIGNAL_ENV, str(int(signal.SIGKILL))))
+    # The injection is a first-class timeline instant, flushed
+    # SYNCHRONOUSLY over the control plane before the signal: SIGKILL
+    # leaves no other trace, and the merged gang timeline must show
+    # the kill at its true (rank, step) for the chaos story to read
+    # kill → classified → resumed. Inert when telemetry is off.
+    from sparkdl_tpu import observe
+
+    observe.instant("chaos.kill", cat="chaos", rank=_rank(),
+                    phase=phase, step=step, sig=sig)
+    observe.flush()
     # Flush whatever the tee has buffered: the postmortem log should
     # show the last step line before the "preemption".
     try:
@@ -131,7 +141,7 @@ def chaos_step(step):
     if int(step) != int(os.environ.get(KILL_STEP_ENV, "0")):
         return
     if _claim_once():
-        _kill_self()
+        _kill_self(phase="step", step=int(step))
 
 
 def on_worker_boot(rank):
@@ -143,12 +153,16 @@ def on_worker_boot(rank):
     if stall > 0:
         stall_rank = os.environ.get(STALL_RANK_ENV)
         if stall_rank is None or int(stall_rank) == rank:
+            from sparkdl_tpu import observe
+
+            observe.instant("chaos.stall", cat="chaos", rank=rank,
+                            stall_s=stall)
             time.sleep(stall)
     if os.environ.get(KILL_PHASE_ENV) == "boot":
         kill_rank = os.environ.get(KILL_RANK_ENV)
         if kill_rank is not None and int(kill_rank) == rank:
             if _claim_once():
-                _kill_self()
+                _kill_self(phase="boot")
 
 
 def control_frame_fate(mtype_name):
@@ -160,6 +174,12 @@ def control_frame_fate(mtype_name):
     if drop and mtype_name in {
         t.strip().upper() for t in drop.split(",") if t.strip()
     }:
+        # Recorded, not flushed: this runs inside the control-plane
+        # send path, and a flush here would recurse into it.
+        from sparkdl_tpu import observe
+
+        observe.instant("chaos.frame_drop", cat="chaos", rank=_rank(),
+                        frame=mtype_name)
         return "drop"
     delay = float(os.environ.get(CP_DELAY_ENV, "0") or 0)
     return delay if delay > 0 else None

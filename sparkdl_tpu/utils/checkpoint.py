@@ -19,6 +19,7 @@ handled distinctly (see ``TrainCheckpointer.__init__``):
 """
 
 import os
+import time
 
 
 def _process_index():
@@ -149,13 +150,27 @@ class TrainCheckpointer:
         Blocks until durable unless ``async_save`` was set."""
         import orbax.checkpoint as ocp
 
+        from sparkdl_tpu import observe
+
         if not should_save():
             return False
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force
-        )
-        if not self._async:
-            self._mgr.wait_until_finished()
+        # Span covers snapshot + (sync mode) the durable write; async
+        # saves show only the snapshot cost here — the overlap is the
+        # feature. Counter + duration histogram feed the alertable
+        # view (a checkpoint stall is a classic silent gang killer).
+        with observe.span("checkpoint.save", cat="checkpoint",
+                          step=int(step), sync=not self._async):
+            t0 = time.perf_counter()
+            saved = self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            )
+            if not self._async:
+                self._mgr.wait_until_finished()
+        if saved:
+            observe.inc("checkpoint_saves_total")
+            observe.observe_value(
+                "checkpoint_save_seconds", time.perf_counter() - t0
+            )
         return saved
 
     def wait_until_finished(self):
@@ -196,11 +211,16 @@ class TrainCheckpointer:
             raise FileNotFoundError(
                 f"no checkpoints found under {self._dir}"
             )
-        if target is not None:
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(target)
-            )
-        return self._mgr.restore(step)
+        from sparkdl_tpu import observe
+
+        with observe.span("checkpoint.restore", cat="checkpoint",
+                          step=int(step)):
+            observe.inc("checkpoint_restores_total")
+            if target is not None:
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(target)
+                )
+            return self._mgr.restore(step)
 
     def close(self):
         if self._mgr_instance is not None:

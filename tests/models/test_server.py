@@ -193,7 +193,11 @@ class _FakeEngine:
             raise fault
         out = {}
         for rid, n in self._queued.items():
-            out[rid] = np.arange(n, dtype=np.int32)
+            toks = np.arange(n, dtype=np.int32)
+            if on_token is not None:    # real engines stream per token
+                for t in toks:
+                    on_token(rid, t)
+            out[rid] = toks
             self.finish_reasons[rid] = "length"
             self.logprobs[rid] = [0.0] * n
         self._queued.clear()
@@ -276,3 +280,83 @@ def test_stream_bad_request_is_400_too():
         assert e.value.code == 400
     finally:
         fe.close()
+
+
+def _get(fe, path):
+    with urllib.request.urlopen(
+            f"http://{fe.address[0]}:{fe.address[1]}{path}",
+            timeout=60) as r:
+        return r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_metrics_endpoint_counts_requests_by_class():
+    """GET /metrics (ISSUE satellite): request counts per error class,
+    queue depth, and request/first-token latency histograms — on the
+    fake engine, so the HTTP accounting is pinned without a model."""
+    fe = ServingFrontend(_FakeEngine(
+        fault=RuntimeError("engine exploded"))).start()
+    try:
+        # engine's fault first (the fake raises once): 500
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 4})
+        assert e.value.code == 500
+        # request's fault: 400 (validated before admission)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 1000})
+        assert e.value.code == 400
+        # two successes (the second streamed)
+        with _post_raw(fe, {"tokens": [1, 2], "max_new_tokens": 3}) as r:
+            assert json.loads(r.read())["tokens"] == [0, 1, 2]
+        with _post_raw(fe, {"tokens": [1], "max_new_tokens": 2,
+                            "stream": True}) as r:
+            assert b'"done"' in r.read()
+
+        ctype, body = _get(fe, "/metrics")
+        assert ctype.startswith("text/plain")
+        assert "# TYPE server_requests_total counter" in body
+        assert 'server_requests_total{code="200"} 2' in body
+        assert 'server_requests_total{code="400"} 1' in body
+        assert 'server_requests_total{code="500"} 1' in body
+        assert "# TYPE server_queue_depth gauge" in body
+        assert "server_queue_depth 0" in body
+        # latency histograms: one series per code, counts match
+        assert 'server_request_seconds_count{code="200"} 2' in body
+        assert 'server_request_seconds_count{code="500"} 1' in body
+        # first-token latency observed once per served request
+        assert "server_first_token_seconds_count 2" in body
+    finally:
+        fe.close()
+
+
+def test_metrics_endpoint_counts_shutdown_503():
+    fe = ServingFrontend(_FakeEngine(fault=KeyboardInterrupt())).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1], "max_new_tokens": 2})
+        assert e.value.code == 503
+        _, body = _get(fe, "/metrics")
+        assert 'server_requests_total{code="503"} 1' in body
+    finally:
+        fe.close()
+
+
+def test_metrics_endpoint_works_without_telemetry_env(monkeypatch):
+    """The serving registry is the frontend's OWN (its /metrics
+    endpoint is API surface) — it must serve data even though gang
+    telemetry is off by default."""
+    monkeypatch.delenv("SPARKDL_TPU_TELEMETRY_DIR", raising=False)
+    from sparkdl_tpu import observe
+    observe._reset_for_tests()
+    try:
+        fe = ServingFrontend(_FakeEngine()).start()
+        try:
+            with _post_raw(fe, {"tokens": [1], "max_new_tokens": 1}) as r:
+                r.read()
+            _, body = _get(fe, "/metrics")
+            assert 'server_requests_total{code="200"} 1' in body
+        finally:
+            fe.close()
+        # ...and none of it leaked into the env-gated global registry
+        assert observe.metrics().snapshot()["counters"] == []
+    finally:
+        observe._reset_for_tests()

@@ -1,0 +1,141 @@
+"""The acceptance proof (ISSUE: observability): a chaos-enabled gang
+run — rank killed at step N, supervised relaunch, checkpoint resume —
+produces ONE merged Chrome trace telling the whole story in order
+(injection → classified transient → resume) with step spans from both
+ranks, and a Prometheus export showing ``gang_restarts_total`` >= 1.
+
+Marked like the PR-1 gang chaos proofs: ``chaos`` + ``slow`` so the
+time-boxed tier-1 gate stays honest and CI runs them in the dedicated
+chaos step.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from sparkdl import HorovodRunner
+from sparkdl_tpu import observe
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    # The enabled flag is latched at first use: re-latch around each
+    # test so the env opt-in here never leaks into later tests.
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _ckpt_train_main(ckpt_dir, total_steps):
+    """Checkpointed, chaos-aware, observe-instrumented training loop
+    (the PR-1 resume main with telemetry on top)."""
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.parallel.train import instrument_step
+    from sparkdl_tpu.utils.chaos import chaos_step
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    hvd.init()
+    ctx = restart_context()     # emits the gang.resume instant
+    ckpt = TrainCheckpointer(ckpt_dir)
+    w = np.zeros((4,), np.float32)
+    start = 0
+    if ctx.resume_step is not None:
+        restored = ckpt.restore(
+            ctx.resume_step, target={"w": np.zeros((4,), np.float32)})
+        w = np.asarray(restored["w"])
+        start = ctx.resume_step + 1
+
+    def one_step(step, w):
+        g = hvd.allreduce(
+            np.full((4,), float((hvd.rank() + 1) * (step + 1)),
+                    np.float32),
+            op=hvd.Sum)
+        return (w - 0.01 * np.asarray(g)).astype(np.float32)
+
+    stepped = instrument_step(one_step)
+    try:
+        for step in range(start, total_steps):
+            w = stepped(step, w)
+            ckpt.save(step, {"w": w})
+            ckpt.wait_until_finished()
+            hvd.barrier()       # rank 0's save durable before any death
+            chaos_step(step)
+    finally:
+        ckpt.close()
+    return {"w": w.tolist(), "attempt": ctx.attempt}
+
+
+@pytest.mark.gang
+@pytest.mark.slow
+def test_chaos_run_renders_as_one_readable_story(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV,
+                       str(tmp_path / "telemetry"))
+    observe._reset_for_tests()
+    monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_BASE", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_MAX", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_RESUME_DIR",
+                       str(tmp_path / "ck"))
+    monkeypatch.setenv("SPARKDL_TPU_ABORT_GRACE", "5")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_KILL_RANK", "1")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_KILL_STEP", "2")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_ONCE_FILE",
+                       str(tmp_path / "one-kill"))
+
+    result = HorovodRunner(np=-2).run(
+        _ckpt_train_main, ckpt_dir=str(tmp_path / "ck"), total_steps=4)
+    assert result["attempt"] == 1          # the relaunch happened
+
+    # ONE merged run dir for the whole supervised launch.
+    run_dirs = glob.glob(str(tmp_path / "telemetry" / "run-*"))
+    assert len(run_dirs) == 1, run_dirs
+    run = run_dirs[0]
+
+    # -- Prometheus view: alertable restart counter -----------------
+    prom = open(os.path.join(run, "metrics.prom")).read()
+    (line,) = [l for l in prom.splitlines()
+               if l.startswith('gang_restarts_total{rank="driver"}')]
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+    assert 'gang_failures_total{rank="driver",verdict="transient"} 1' \
+        in prom
+    assert 'gang_attempts_total{rank="driver"} 2' in prom
+
+    # -- merged timeline: the story, in order -----------------------
+    trace = json.loads(open(os.path.join(run, "timeline.json")).read())
+    events = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+
+    # worker step spans from >= 2 ranks (driver lane 0, rank r lane r+1)
+    step_lanes = {e["pid"] for e in events
+                  if e["name"] == "train_step" and e["ph"] == "X"}
+    assert {1, 2} <= step_lanes
+
+    def first_ts(name, **match):
+        cands = [
+            e["ts"] for e in events
+            if e["name"] == name
+            and all(e["args"].get(k) == v for k, v in match.items())
+        ]
+        assert cands, (
+            f"event {name} {match} missing; have "
+            f"{sorted({e['name'] for e in events})}")
+        return min(cands)
+
+    kill_ts = first_ts("chaos.kill", rank=1, step=2)
+    classified_ts = first_ts("gang.failure", verdict="transient")
+    resume_ts = first_ts("gang.resume", attempt=1)
+    assert kill_ts < classified_ts < resume_ts
+    # the classified failure names the preemption-shaped cause
+    (fail_ev,) = [e for e in events if e["name"] == "gang.failure"]
+    assert "sig" in fail_ev["args"]["cause"]
+    # checkpoint activity is on the timeline too: saves before the
+    # kill, the resume-time restore after the relaunch
+    assert any(e["name"] == "checkpoint.save" for e in events)
+    restore_ts = first_ts("checkpoint.restore")
+    assert restore_ts > kill_ts

@@ -1,0 +1,197 @@
+"""Driver-side aggregation: unit-level ingest/write plus the real
+control-plane round-trip in a local gang (ISSUE satellite: aggregation
+round-trip in a real local gang)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe.aggregate import GangTelemetry
+from sparkdl_tpu.observe.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _payload(pid, host="hostA", counters=(), events=()):
+    reg = Registry()
+    for name, value in counters:
+        reg.counter(name).inc(value)
+    return {"pid": pid, "host": host, "metrics": reg.snapshot(),
+            "events": list(events)}
+
+
+def _instant(name, ts):
+    return {"name": name, "cat": "t", "ph": "i", "ts": ts, "s": "p",
+            "tid": 1, "args": {}}
+
+
+def test_ingest_merges_incarnations_and_write_produces_artifacts(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    # rank 0: two flushes from pid 100 (cumulative: latest wins), then
+    # a relaunch incarnation pid 200 (sums with pid 100's latest).
+    gt.ingest(0, _payload(100, counters=[("steps_total", 2)],
+                          events=[_instant("a", 10)]))
+    gt.ingest(0, _payload(100, counters=[("steps_total", 5)],
+                          events=[_instant("b", 20)]))
+    gt.ingest(0, _payload(200, counters=[("steps_total", 3)]))
+    gt.ingest(1, _payload(300, host="hostB",
+                          counters=[("steps_total", 7)],
+                          events=[_instant("c", 15)]))
+    # driver-side state rides the global registry/timeline
+    observe.metrics().counter("gang_restarts_total").inc()
+    observe.timeline().instant("gang.failure", cat="supervisor")
+
+    paths = gt.write(str(tmp_path))
+    prom = open(paths["metrics.prom"]).read()
+    assert 'steps_total{rank="0"} 8' in prom      # 5 (latest) + 3
+    assert 'steps_total{rank="1"} 7' in prom
+    assert 'gang_restarts_total{rank="driver"} 1' in prom
+
+    doc = json.loads(open(paths["metrics.json"]).read())
+    ranks = {s["labels"]["rank"] for s in doc["series"]}
+    assert ranks == {"driver", "0", "1"}
+
+    trace = json.loads(open(paths["timeline.json"]).read())
+    events = trace["traceEvents"]
+    lanes = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert lanes == {"driver", "rank 0 @ hostA", "rank 1 @ hostB"}
+    named = {e["name"] for e in events if e["ph"] != "M"}
+    assert {"a", "b", "c", "gang.failure"} <= named
+
+
+def test_malformed_snapshot_is_rejected():
+    gt = GangTelemetry()
+    with pytest.raises(ValueError, match="malformed"):
+        gt.ingest(0, {"pid": 1, "metrics": {"counters": [{"name": 5}]}})
+
+
+def test_write_is_atomic_no_tmp_left_behind(tmp_path, monkeypatch):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    gt.ingest(0, _payload(1, counters=[("c_total", 1)]))
+    gt.write(str(tmp_path))
+    assert not glob.glob(str(tmp_path / "*.tmp"))
+
+
+# -- the real thing: a local gang round trip --------------------------------
+
+
+def _instrumented_main(n_steps):
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu import observe
+    from sparkdl_tpu.parallel.train import instrument_step
+
+    hvd.init()
+
+    def step(x):
+        # one real collective per step: lands in collective_* metrics
+        return hvd.allreduce(x, op=hvd.Sum)
+
+    stepped = instrument_step(step)
+    for i in range(n_steps):
+        stepped(np.full((8,), float(hvd.rank() + 1), np.float32))
+    observe.inc("main_markers_total")
+    return {"rank": hvd.rank(), "size": hvd.size(),
+            "telemetry_on": observe.enabled()}
+
+
+@pytest.mark.gang
+def test_control_plane_round_trip_in_real_gang(monkeypatch, tmp_path):
+    """Workers flush over TELEMETRY frames; the driver writes ONE
+    merged run dir with per-rank metrics and a timeline carrying
+    events from both ranks plus the driver lane."""
+    from sparkdl import HorovodRunner
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+
+    result = HorovodRunner(np=-2).run(_instrumented_main, n_steps=3)
+    assert result["telemetry_on"] is True
+
+    run_dirs = glob.glob(str(tmp_path / "run-*"))
+    assert len(run_dirs) == 1, run_dirs
+    run = run_dirs[0]
+
+    prom = open(os.path.join(run, "metrics.prom")).read()
+    for rank in (0, 1):
+        assert f'main_markers_total{{rank="{rank}"}} 1' in prom
+        assert f'collective_ops_total{{op="reduce",rank="{rank}"}}' in prom
+        assert (f'train_step_total{{phase="execute",rank="{rank}"}} 2'
+                in prom)
+    assert 'gang_attempts_total{rank="driver"} 1' in prom
+
+    trace = json.loads(open(os.path.join(run, "timeline.json")).read())
+    events = trace["traceEvents"]
+    # step spans from BOTH worker lanes (driver is lane 0, rank r is
+    # lane r+1)
+    step_lanes = {e["pid"] for e in events
+                  if e.get("name") == "train_step" and e["ph"] == "X"}
+    assert {1, 2} <= step_lanes
+    names = {e.get("name") for e in events}
+    assert {"worker.start", "worker.ready", "gang.spawn",
+            "gang.rendezvous"} <= names
+
+    json.loads(open(os.path.join(run, "metrics.json")).read())  # valid
+
+
+@pytest.mark.gang
+def test_gang_without_telemetry_writes_nothing(monkeypatch, tmp_path):
+    """Off by default: no env, no run dirs, no TELEMETRY frames, and
+    the worker mains see the zero-overhead path."""
+    from sparkdl import HorovodRunner
+
+    monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+    observe._reset_for_tests()
+    result = HorovodRunner(np=-2).run(_instrumented_main, n_steps=1)
+    assert result["telemetry_on"] is False
+    assert glob.glob(str(tmp_path / "run-*")) == []
+
+
+def test_second_launch_does_not_inherit_driver_counters(
+        tmp_path, monkeypatch):
+    """The driver registry spans launches; each GangTelemetry baselines
+    it at construction so run N's artifacts report only run N."""
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt1 = GangTelemetry()
+    observe.metrics().counter("gang_restarts_total").inc()
+    gt1.write(str(tmp_path / "a"))
+    prom1 = open(tmp_path / "a" / "metrics.prom").read()
+    assert 'gang_restarts_total{rank="driver"} 1' in prom1
+
+    gt2 = GangTelemetry()   # second launch: baseline includes the 1
+    observe.metrics().counter("gang_attempts_total").inc()
+    gt2.write(str(tmp_path / "b"))
+    prom2 = open(tmp_path / "b" / "metrics.prom").read()
+    assert "gang_restarts_total" not in prom2      # run 1's, not run 2's
+    assert 'gang_attempts_total{rank="driver"} 1' in prom2
+
+
+def test_malformed_histogram_and_values_rejected_at_ingest():
+    gt = GangTelemetry()
+    # counts shorter than buckets+1
+    with pytest.raises(ValueError, match="malformed histogram"):
+        gt.ingest(0, {"pid": 1, "metrics": {"histograms": [
+            {"name": "h", "labels": {}, "buckets": [1.0, 2.0],
+             "counts": [1], "sum": 0.5, "count": 1}]}})
+    # non-numeric counter value
+    with pytest.raises(ValueError, match="malformed metric"):
+        gt.ingest(0, {"pid": 1, "metrics": {"counters": [
+            {"name": "c", "labels": {}, "value": "NaNope"}]}})
+    # nothing half-ingested: a clean write still works
+    gt.ingest(0, _payload(1, counters=[("ok_total", 1)]))
+    assert gt._merged()[0][1]["counters"][0]["name"] == "ok_total"

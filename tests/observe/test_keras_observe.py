@@ -1,0 +1,64 @@
+"""LogCallback ↔ observe wiring (ISSUE satellite): epoch/batch metrics
+flow into the telemetry layer while the log-line contract — the
+surface the API-lock tests pin — stays byte-identical."""
+
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from sparkdl_tpu import observe  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _run_one_epoch(cb):
+    cb.on_epoch_begin(0)
+    cb.on_batch_end(0, logs={"loss": 1.25})
+    cb.on_batch_end(1, logs={"loss": 1.0})
+    cb.on_epoch_end(0, logs={"loss": 0.75, "accuracy": 0.5})
+
+
+def test_logcallback_emits_observe_metrics(monkeypatch, tmp_path, capsys):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    from sparkdl.horovod.tensorflow.keras import LogCallback
+
+    _run_one_epoch(LogCallback())
+
+    # Log lines unchanged (outside a gang, log_to_driver prints):
+    out = capsys.readouterr().out
+    assert "Epoch 0 begin at " in out
+    assert "Epoch 0 end (" in out
+    assert "loss: 0.7500 - accuracy: 0.5000" in out
+    assert "batch" not in out          # per_batch_log=False: no lines
+
+    # ... but the metrics made it into the observe layer:
+    snap = observe.metrics().snapshot()
+    gauges = {(g["name"], g["labels"].get("scope")): g["value"]
+              for g in snap["gauges"]}
+    assert gauges[("keras_loss", "batch")] == 1.0     # latest batch
+    assert gauges[("keras_loss", "epoch")] == 0.75
+    assert gauges[("keras_accuracy", "epoch")] == 0.5
+    (hist,) = snap["histograms"]
+    assert hist["name"] == "keras_epoch_seconds" and hist["count"] == 1
+    names = [e["name"] for e in observe.timeline().drain()]
+    assert names.count("keras.epoch_begin") == 1
+    assert names.count("keras.epoch_end") == 1
+
+
+def test_logcallback_inert_without_telemetry(monkeypatch, capsys):
+    monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+    observe._reset_for_tests()
+    from sparkdl.horovod.tensorflow.keras import LogCallback
+
+    _run_one_epoch(LogCallback(per_batch_log=True))
+    out = capsys.readouterr().out
+    assert "Epoch 0 batch 1: loss: 1.0000" in out   # lines still flow
+    snap = observe.metrics().snapshot()
+    assert snap["gauges"] == [] and snap["histograms"] == []
+    assert len(observe.timeline()) == 0

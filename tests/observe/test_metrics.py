@@ -1,0 +1,183 @@
+"""Metrics registry: concurrency, histogram bucketing, exporter golden
+outputs, and cross-incarnation snapshot merging (ISSUE: observability
+tentpole)."""
+
+import json
+import threading
+
+import pytest
+
+from sparkdl_tpu.observe.metrics import (
+    DEFAULT_BUCKETS,
+    Registry,
+    merge_snapshots,
+    render_json,
+    render_prometheus,
+)
+
+
+def test_counter_concurrent_increments_never_lose_updates():
+    reg = Registry()
+    c = reg.counter("ops_total", op="sum")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+def test_counter_is_monotonic():
+    with pytest.raises(ValueError):
+        Registry().counter("x_total").inc(-1)
+
+
+def test_labels_create_distinct_series_and_order_does_not_matter():
+    reg = Registry()
+    reg.counter("c_total", op="sum", rank="0").inc()
+    reg.counter("c_total", rank="0", op="sum").inc()   # same series
+    reg.counter("c_total", op="max", rank="0").inc()   # different
+    snap = reg.snapshot()
+    values = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["counters"]}
+    assert values[(("op", "sum"), ("rank", "0"))] == 2
+    assert values[(("op", "max"), ("rank", "0"))] == 1
+
+
+def test_name_kind_conflict_raises():
+    reg = Registry()
+    reg.counter("thing")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("thing")
+
+
+def test_histogram_bucketing_cumulative_and_inf_catchall():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", buckets=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    # Per-bin (non-cumulative) internal counts: [<=0.01, <=0.1, <=1, +Inf]
+    assert h.counts == [1, 2, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(5.605)
+    # Boundary lands in its own bucket (le is inclusive).
+    h.observe(0.01)
+    assert h.counts[0] == 2
+
+
+def test_histogram_bucket_layout_is_pinned_per_name():
+    reg = Registry()
+    a = reg.histogram("h", buckets=[1, 2], op="x")
+    b = reg.histogram("h", op="y")   # inherits the pinned layout
+    assert a.buckets == b.buckets == (1.0, 2.0)
+    assert reg.histogram("other").buckets == tuple(sorted(DEFAULT_BUCKETS))
+
+
+def test_prometheus_golden_output():
+    reg = Registry()
+    reg.counter("gang_restarts_total").inc()
+    reg.gauge("steps_per_second", rank="0").set(12.5)
+    h = reg.histogram("step_seconds", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(2.0)
+    assert reg.to_prometheus() == (
+        "# TYPE gang_restarts_total counter\n"
+        "gang_restarts_total 1\n"
+        "# TYPE step_seconds histogram\n"
+        'step_seconds_bucket{le="0.1"} 1\n'
+        'step_seconds_bucket{le="1"} 1\n'
+        'step_seconds_bucket{le="+Inf"} 2\n'
+        "step_seconds_sum 2.05\n"
+        "step_seconds_count 2\n"
+        "# TYPE steps_per_second gauge\n"
+        'steps_per_second{rank="0"} 12.5\n'
+    )
+
+
+def test_prometheus_label_escaping():
+    reg = Registry()
+    reg.counter("c_total", why='say "hi"\nback\\slash').inc()
+    out = reg.to_prometheus()
+    assert r'why="say \"hi\"\nback\\slash"' in out
+    assert "\nback" not in out.replace("\\n", "")  # no raw newline inside
+
+
+def test_json_export_round_trips():
+    reg = Registry()
+    reg.counter("c_total", op="sum").inc(2)
+    reg.histogram("h_seconds", buckets=[1]).observe(0.5)
+    doc = json.loads(reg.to_json())
+    assert "generated_at" in doc
+    (series,) = doc["series"]
+    assert series["counters"] == [
+        {"name": "c_total", "labels": {"op": "sum"}, "value": 2}
+    ]
+    (h,) = series["histograms"]
+    assert h["buckets"] == [1] and h["counts"] == [1, 0]
+
+
+def test_merge_snapshots_sums_counters_and_keeps_newest_gauge():
+    reg1, reg2 = Registry(), Registry()
+    reg1.counter("ops_total").inc(3)
+    reg1.gauge("depth").set(5)
+    reg1.histogram("h", buckets=[1]).observe(0.5)
+    s1 = reg1.snapshot()
+    reg2.counter("ops_total").inc(4)
+    reg2.gauge("depth").set(7)
+    reg2.histogram("h", buckets=[1]).observe(2.0)
+    s2 = reg2.snapshot()
+    s2["ts"] = s1["ts"] + 10
+    merged = merge_snapshots([s1, s2])
+    assert merged["counters"] == [
+        {"name": "ops_total", "labels": {}, "value": 7}
+    ]
+    assert merged["gauges"] == [{"name": "depth", "labels": {}, "value": 7}]
+    (h,) = merged["histograms"]
+    assert h["counts"] == [1, 1] and h["count"] == 2
+    assert h["sum"] == pytest.approx(2.5)
+
+
+def test_render_prometheus_with_rank_labels():
+    reg = Registry()
+    reg.counter("ops_total").inc(2)
+    out = render_prometheus([
+        ({"rank": "driver"}, reg.snapshot()),
+        ({"rank": "0"}, reg.snapshot()),
+    ])
+    assert 'ops_total{rank="0"} 2' in out
+    assert 'ops_total{rank="driver"} 2' in out
+    assert out.count("# TYPE ops_total counter") == 1
+
+
+def test_render_json_carries_extra_labels():
+    reg = Registry()
+    reg.counter("c_total").inc()
+    doc = json.loads(render_json([({"rank": "1"}, reg.snapshot())]))
+    assert doc["series"][0]["labels"] == {"rank": "1"}
+
+
+def test_snapshot_delta_reports_only_this_runs_movement():
+    from sparkdl_tpu.observe.metrics import snapshot_delta
+
+    reg = Registry()
+    reg.counter("restarts_total").inc(2)
+    reg.counter("untouched_total").inc(5)
+    reg.histogram("h", buckets=[1]).observe(0.5)
+    reg.gauge("depth").set(3)
+    base = reg.snapshot()
+    reg.counter("restarts_total").inc()          # +1 this run
+    reg.histogram("h", buckets=[1]).observe(2.0)  # +1 obs this run
+    reg.gauge("depth").set(9)
+    delta = snapshot_delta(base, reg.snapshot())
+    assert delta["counters"] == [
+        {"name": "restarts_total", "labels": {}, "value": 1}
+    ]  # untouched_total dropped: it did not move
+    (h,) = delta["histograms"]
+    assert h["counts"] == [0, 1] and h["count"] == 1
+    assert h["sum"] == pytest.approx(2.0)
+    assert delta["gauges"] == [{"name": "depth", "labels": {}, "value": 9}]
