@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast bench native docs clean
+.PHONY: test test-fast bench bench-first native docs clean
 
 test:
 	python -m pytest tests/ -q
@@ -10,6 +10,14 @@ test-fast:          # skip multiprocess gang tests (each worker imports jax/tf)
 
 bench:              # single-chip headline bench (run on a TPU host)
 	python bench.py
+
+bench-first:        # bench BEFORE the test suite claims the accelerator
+	# Ordering contract (bench.py docstring): pytest holds the PJRT
+	# plugin / chip lease for its whole time-boxed run, so a bench
+	# started after it only ever sees probe timeouts. Measure first,
+	# then hand the chip to the tests.
+	python bench.py
+	python -m pytest tests/ -q
 
 bench-all:          # every TPU artifact in one lease session
 	bash benchmarks/tpu_homecoming.sh

@@ -136,6 +136,7 @@ def _load_builtin_passes():
     # sparkdl_tpu.analysis` stays jax-free.
     from sparkdl_tpu.analysis import (  # noqa: F401
         passes_collectives,
+        passes_donation,
         passes_dtype,
         passes_host,
     )

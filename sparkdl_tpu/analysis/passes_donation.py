@@ -1,0 +1,316 @@
+"""Buffer-donation pass: params/opt_state-sized inputs that are not
+donated double peak HBM.
+
+A train step is an in-place update by nature — ``params`` and
+``opt_state`` go in, their replacements come out — so XLA can reuse
+the input buffers for the outputs *if* the caller donates them
+(``jax.jit(step, donate_argnums=(0, 1))``). The repo's own bench and
+the serving decode path donate; user ``main``s routinely forget, and
+the cost is silent: the step still runs, it just holds TWO copies of
+everything params-sized at peak (old + new), which at Llama scale is
+the difference between fitting and OOMing.
+
+Donation is visible in the lowered StableHLO module's entry
+signature: a donated-and-aliased argument carries
+``tf.aliasing_output``, a donated-but-unaliased one
+``jax.buffer_donor``. This pass reads that signature:
+
+- **WARNING** (precise, needs ``param_info``): an undonated entry
+  argument whose (dtype, shape) exactly matches a parameter leaf —
+  the same matching the full-param-allgather pass uses — AND for
+  which a same-signature *output* remains to alias into (the output
+  multiset is the true donation budget: it counts every opt_state
+  tree riding param shapes, adamw's mu and nu both, and keeps
+  inference forwards — whose params have no matching output and so
+  cannot be donated — silent). The message totals the doubled bytes.
+- **INFO** (heuristic, no ``param_info``): the module donates
+  *nothing at all* and carries large inputs (>=
+  ``options["donation_min_elements"]``, default 2**24 elements — the
+  scale where a doubled buffer is HBM that matters, and safely above
+  the repo's own small clean models) — the
+  forgot-``donate_argnums``-entirely pattern. A module that donates
+  at least one argument clearly made a donation decision; the
+  heuristic stays quiet there rather than second-guess the batch.
+"""
+
+import re
+
+from sparkdl_tpu.analysis.core import Finding, Severity, register_pass
+
+_RULE = "undonated-step-buffers"
+
+DEFAULT_MIN_ELEMENTS = 1 << 24
+
+# MLIR element types as they appear in tensor<...> -> numpy-style
+# dtype names (ParamInfo.dtype is str(leaf.dtype)).
+_MLIR_DTYPES = {
+    "f64": "float64", "f32": "float32", "f16": "float16",
+    "bf16": "bfloat16",
+    "f8E4M3FN": "float8_e4m3fn", "f8E5M2": "float8_e5m2",
+    "i64": "int64", "i32": "int32", "i16": "int16", "i8": "int8",
+    "si64": "int64", "si32": "int32", "si16": "int16", "si8": "int8",
+    "ui64": "uint64", "ui32": "uint32", "ui16": "uint16",
+    "ui8": "uint8", "i1": "bool",
+    "c64": "complex64", "c128": "complex128",
+}
+
+_DTYPE_BYTES = {
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "int64": 8, "int32": 4, "int16": 2, "int8": 1,
+    "uint64": 8, "uint32": 4, "uint16": 2, "uint8": 1, "bool": 1,
+    "complex64": 8, "complex128": 16,
+}
+
+_DONATION_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+def _main_signature(stablehlo_text):
+    """The argument list text of ``@main(...)``, extracted by paren
+    depth (attribute dicts and ``loc(...)`` suffixes nest balanced
+    parens/braces, so a regex to the first ``)`` would truncate)."""
+    m = re.search(r"func\.func\s+(?:public\s+)?@main\(", stablehlo_text)
+    if m is None:
+        return None
+    start = m.end() - 1
+    depth = 0
+    for j in range(start, len(stablehlo_text)):
+        ch = stablehlo_text[j]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return stablehlo_text[start + 1:j]
+    return None
+
+
+def main_args(stablehlo_text):
+    """``[(index, shape_tuple_or_None, dtype_str_or_None, donation)]``
+    for the entry computation's tensor arguments, where ``donation``
+    is ``"alias"`` (``tf.aliasing_output`` — donated and aliased onto
+    a specific output), ``"donor"`` (``jax.buffer_donor`` — donated
+    but consuming no output slot), or ``None`` (undonated; both
+    donation spellings are truthy, so ``if donation:`` reads as "is
+    donated").
+
+    The signature is split per ``%argN:`` and donation attrs are
+    substring-matched against each argument's whole chunk rather than
+    regex-captured out of the attr dict: MLIR prints dict attributes
+    alphabetically, so ``tf.aliasing_output`` follows an
+    ``mhlo.sharding = "{devices=[...]}"`` string whose nested braces
+    would truncate any ``\\{[^}]*\\}`` capture — exactly on the
+    sharded programs this pass most cares about. The attr names
+    cannot occur in a tensor type or ``loc(...)``, so the substring
+    match is precise."""
+    sig = _main_signature(stablehlo_text)
+    if sig is None:
+        return []
+    args = []
+    for chunk in re.split(r",\s*(?=%arg\d+\s*:)", sig):
+        m = re.match(r"\s*%arg(\d+)\s*:\s*tensor<([^>]*)>", chunk)
+        if m is None:
+            continue
+        idx = int(m.group(1))
+        dims = m.group(2).split("x")
+        dtype = _MLIR_DTYPES.get(dims[-1])
+        shape = None
+        if dtype is not None:
+            try:
+                shape = tuple(int(d) for d in dims[:-1])
+            except ValueError:   # dynamic dims — size unknowable
+                shape = None
+        if "tf.aliasing_output" in chunk:
+            donation = "alias"
+        elif "jax.buffer_donor" in chunk:
+            donation = "donor"
+        else:
+            donation = None
+        args.append((idx, shape, dtype, donation))
+    return args
+
+
+def main_results(stablehlo_text):
+    """``[(shape_tuple_or_None, dtype_str_or_None)]`` for the entry
+    computation's result types (the ``-> (...)`` clause). Donation is
+    only possible when an output of the same (dtype, shape) exists for
+    XLA to alias the input into — the output multiset is the true
+    donation budget."""
+    m = re.search(r"func\.func\s+(?:public\s+)?@main\(", stablehlo_text)
+    if m is None:
+        return []
+    depth = 0
+    end = None
+    for j in range(m.end() - 1, len(stablehlo_text)):
+        ch = stablehlo_text[j]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    if end is None:
+        return []
+    rest = stablehlo_text[end + 1:]
+    arrow = re.match(r"\s*->\s*", rest)
+    if arrow is None:
+        return []
+    rest = rest[arrow.end():]
+    if rest.startswith("("):
+        depth = 0
+        for j, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    results_text = rest[1:j]
+                    break
+        else:
+            return []
+    else:
+        # single unparenthesized result: up to the body brace
+        results_text = rest.split("{", 1)[0]
+    out = []
+    for tm in re.finditer(r"tensor<([^>]*)>", results_text):
+        dims = tm.group(1).split("x")
+        dtype = _MLIR_DTYPES.get(dims[-1])
+        shape = None
+        if dtype is not None:
+            try:
+                shape = tuple(int(d) for d in dims[:-1])
+            except ValueError:
+                shape = None
+        out.append((shape, dtype))
+    return out
+
+
+def _output_budget(stablehlo_text, args):
+    """Donation slots per (dtype, shape): the output multiset, minus
+    one slot for every ``tf.aliasing_output`` argument (those consume
+    a concrete output). ``jax.buffer_donor`` args are donated but
+    alias nothing, so they must NOT shrink the budget — doing so
+    would undercount the remaining undonated state. What remains is
+    how many MORE inputs of that signature could actually be
+    donated."""
+    budget = {}
+    for shape, dtype in main_results(stablehlo_text):
+        if shape is not None:
+            key = (dtype, shape)
+            budget[key] = budget.get(key, 0) + 1
+    for _, shape, dtype, donation in args:
+        if donation == "alias" and shape is not None:
+            key = (dtype, shape)
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+    return budget
+
+
+def _nbytes(shape, dtype):
+    n = _DTYPE_BYTES.get(dtype, 4)
+    for d in shape:
+        n *= d
+    return n
+
+
+@register_pass(_RULE, requires=("stablehlo_text",))
+def undonated_step_buffers(ctx):
+    """Flag params/opt_state-sized step inputs that are not donated
+    (peak HBM holds old + new copies of everything undonated)."""
+    args = main_args(ctx.stablehlo_text)
+    if not args:
+        return []
+
+    if ctx.param_info:
+        # Precise mode: an undonated arg is flagged when (a) its
+        # (dtype, shape) exactly matches a parameter leaf — as the
+        # full-param-allgather pass matches them — AND (b) an output
+        # of that signature remains for XLA to alias it into. The
+        # output multiset is the true donation budget: it naturally
+        # covers every opt_state tree that rides param shapes (adamw's
+        # mu AND nu both come back out) and stays SILENT on
+        # inference/eval forwards, whose params have no same-shaped
+        # output and therefore cannot be donated at all — advising
+        # donation there would be the cry-wolf failure mode.
+        budget = _output_budget(ctx.stablehlo_text, args)
+        param_sigs = {
+            (info.dtype, info.shape) for info in ctx.param_info
+        }
+        matched = []
+        for idx, shape, dtype, donated in args:
+            if donated or shape is None:
+                continue
+            key = (dtype, shape)
+            if key in param_sigs and budget.get(key, 0) > 0:
+                budget[key] -= 1
+                matched.append((idx, shape, dtype))
+        if not matched:
+            return []
+        total = sum(_nbytes(s, d) for _, s, d in matched)
+        head = ", ".join(
+            f"%arg{i} {d}{list(s)}" for i, s, d in matched[:4]
+        )
+        more = "" if len(matched) <= 4 else f", +{len(matched) - 4} more"
+        return [Finding(
+            rule_id=_RULE,
+            severity=Severity.WARNING,
+            op="main",
+            location="",
+            message=(
+                f"{len(matched)} step input(s) matching parameter "
+                f"leaves are not donated ({head}{more}; "
+                f"{total / 2**20:.1f} MiB): without "
+                "donate_argnums the output buffers cannot reuse the "
+                "inputs, so peak HBM holds old AND new copies of "
+                "everything params/opt_state-sized. Donate the "
+                "carried state: jax.jit(step, donate_argnums=(0, 1))."
+            ),
+        )]
+
+    # Heuristic mode: no param tree to match against. Only the
+    # donated-nothing-at-all module is flagged — if the author donated
+    # anything, the undonated rest is a decision, not an oversight —
+    # and only inputs an output slot could actually absorb (a pure
+    # forward's params have none and cannot be donated).
+    if any(donated for _, _, _, donated in args):
+        return []
+    min_elements = int(
+        ctx.options.get("donation_min_elements", DEFAULT_MIN_ELEMENTS)
+    )
+    budget = _output_budget(ctx.stablehlo_text, args)
+    big = []
+    for i, s, d, _ in args:
+        if s is None or _elements(s) < min_elements:
+            continue
+        key = (d, s)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            big.append((i, s, d))
+    if not big:
+        return []
+    head = ", ".join(f"%arg{i} {d}{list(s)}" for i, s, d in big[:4])
+    more = "" if len(big) <= 4 else f", +{len(big) - 4} more"
+    total = sum(_nbytes(s, d) for _, s, d in big)
+    return [Finding(
+        rule_id=_RULE,
+        severity=Severity.INFO,
+        op="main",
+        location="",
+        message=(
+            f"no entry argument is donated, and {len(big)} large "
+            f"input(s) ({head}{more}; {total / 2**20:.1f} MiB) look "
+            "like carried train state: if this step returns updated "
+            "params/opt_state, donate them (jax.jit(step, "
+            "donate_argnums=...)) or peak HBM doubles. Ignore for "
+            "pure-inference programs whose inputs must survive the "
+            "call."
+        ),
+    )]
+
+
+def _elements(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n

@@ -180,6 +180,15 @@ def main():
     # 1. Platform selection must happen before any JAX backend init.
     _state.ensure_jax_platform()
 
+    # 1b. Warm-start compilation: point JAX's persistent compile cache
+    # at the gang-wide dir BEFORE backend init, so this worker — a
+    # fresh attempt's relaunch included — reuses every XLA artifact a
+    # previous incarnation paid for. No-op unless the launcher shipped
+    # SPARKDL_TPU_COMPILE_CACHE_DIR (see sparkdl_tpu/parallel/compile).
+    from sparkdl_tpu.parallel.compile import enable_persistent_cache
+
+    enable_persistent_cache()
+
     exit_code = 0
     try:
         # 2. Control plane + log tee (before anything can print).

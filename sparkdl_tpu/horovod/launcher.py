@@ -36,6 +36,14 @@ from sparkdl_tpu.horovod.topology import HOSTS_ENV
 from sparkdl_tpu.hvd._state import COORD_ENV
 
 COORD_PORT_ENV = "SPARKDL_TPU_COORDINATOR_PORT"
+# Warm-start compilation: when the driver sets this env, every worker
+# env carries it (local Popen children inherit it via _worker_env's
+# base_env copy; remote ranks ride the SPARKDL_TPU_* forward), every
+# supervised relaunch re-ships it, and _worker.py points JAX's
+# persistent compile cache at it before backend init. The module is
+# import-light (jax only inside functions), so the launcher can take
+# the constant from its canonical home.
+from sparkdl_tpu.parallel.compile import COMPILE_CACHE_DIR_ENV
 
 logger = logging.getLogger("HorovodRunner")
 
@@ -698,9 +706,20 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             "Launching HorovodRunner gang: %d worker(s), mode=%s, job_dir=%s",
             num_workers, mode, job_dir,
         )
+        compile_cache = os.environ.get(COMPILE_CACHE_DIR_ENV)
+        if compile_cache:
+            # Relaunches of a preempted gang warm-start from here: the
+            # env rides every worker env (and every supervised
+            # attempt), so the replacement rank deserializes instead
+            # of recompiling.
+            logger.info(
+                "warm-start compile cache for this gang: %s",
+                compile_cache,
+            )
         observe.instant("gang.spawn", cat="launch",
                         num_workers=num_workers, mode=mode,
-                        job_dir=job_dir)
+                        job_dir=job_dir,
+                        compile_cache=compile_cache or "")
         for r in range(num_workers):
             env = _worker_env(
                 os.environ, rank=r, size=num_workers,

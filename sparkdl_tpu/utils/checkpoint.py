@@ -223,5 +223,18 @@ class TrainCheckpointer:
             return self._mgr.restore(step)
 
     def close(self):
+        """Join any in-flight async save, THEN dispose the manager.
+
+        A train loop's natural shutdown (``finally: ckpt.close()``)
+        can land microseconds after an async ``save()`` returned —
+        tearing the manager down while its background write is
+        mid-flight would abandon a temp dir where a committed step
+        should be, and the *final* checkpoint of a run is exactly the
+        one a resume needs. ``wait_until_finished`` first makes close
+        a commit point. Failures in the join still dispose the
+        manager (a wedged writer must not leak it)."""
         if self._mgr_instance is not None:
-            self._mgr_instance.close()
+            try:
+                self._mgr_instance.wait_until_finished()
+            finally:
+                self._mgr_instance.close()

@@ -16,9 +16,12 @@ Every wrapper prefers the stable modern API and falls back to the
 
 Also home to the version-stable lowering/jaxpr accessors the static
 analysis subsystem builds on (``lower``, ``lowered_stablehlo``,
-``compiled_hlo``, ``closed_jaxpr``, ``x64_enabled``) and the runtime
-feature probe ``old_xla_spmd_partitioner()`` that tier-1 tests gate
-on instead of failing against the jax-0.4.x XLA.
+``compiled_hlo``, ``closed_jaxpr``, ``x64_enabled``), the
+warm-start-compilation shims (``enable_compilation_cache``,
+``serialize_compiled``/``deserialize_compiled`` — see
+:mod:`sparkdl_tpu.parallel.compile`), and the runtime feature probe
+``old_xla_spmd_partitioner()`` that tier-1 tests gate on instead of
+failing against the jax-0.4.x XLA.
 """
 
 
@@ -114,6 +117,67 @@ def closed_jaxpr(fn, *args, **kwargs):
     import jax
 
     return jax.make_jaxpr(fn)(*args, **kwargs)
+
+
+def enable_compilation_cache(path, *, min_compile_time_secs=None,
+                             min_entry_size_bytes=None):
+    """Point JAX's persistent compilation cache at ``path``.
+
+    Modern jax spells every knob as a config option
+    (``jax_compilation_cache_dir`` et al.); older lines predating some
+    of the threshold knobs get the directory via
+    ``jax.experimental.compilation_cache.set_cache_dir`` and whatever
+    threshold options exist. Unknown knobs are skipped per-name, never
+    fatal — a missing tuning option must not disable the cache."""
+    import jax
+
+    def _set(option, value):
+        try:
+            jax.config.update(option, value)
+            return True
+        except (AttributeError, ValueError, KeyError):
+            return False
+
+    _set("jax_enable_compilation_cache", True)
+    if not _set("jax_compilation_cache_dir", path):
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc,
+        )
+
+        cc.set_cache_dir(path)
+    if min_compile_time_secs is not None:
+        _set("jax_persistent_cache_min_compile_time_secs",
+             min_compile_time_secs)
+    if min_entry_size_bytes is not None:
+        _set("jax_persistent_cache_min_entry_size_bytes",
+             min_entry_size_bytes)
+    # Cache problems (corrupt entry, unwritable dir) must degrade to a
+    # cold compile with a warning, never crash the step. This is the
+    # default on both lines; pin it in case a site config flipped it.
+    _set("jax_raise_persistent_cache_errors", False)
+
+
+def serialize_compiled(compiled):
+    """``(payload_bytes, in_tree, out_tree)`` for a
+    ``jax.stages.Compiled``: prefers the object's own ``serialize``
+    (newer jax), else ``jax.experimental.serialize_executable`` (both
+    return the same triple)."""
+    if hasattr(compiled, "serialize"):
+        return compiled.serialize()
+    from jax.experimental.serialize_executable import serialize
+
+    return serialize(compiled)
+
+
+def deserialize_compiled(payload, in_tree, out_tree):
+    """Rebuild a ready-to-call ``Compiled`` from
+    :func:`serialize_compiled` output (stable spelling on both
+    lines)."""
+    from jax.experimental.serialize_executable import (
+        deserialize_and_load,
+    )
+
+    return deserialize_and_load(payload, in_tree, out_tree)
 
 
 def tpu_compiler_params(**kwargs):

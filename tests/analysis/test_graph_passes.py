@@ -404,3 +404,192 @@ def test_param_info_ignores_size_one_axes():
     pr = {"w": jnp.ones((4, 4))}
     (info,) = param_info_from(pr, sh)
     assert info.sharded_axes == ()
+
+
+# ---------------------------------------------------------------------------
+# undonated-step-buffers
+# ---------------------------------------------------------------------------
+
+
+class TestUndonatedStepBuffers:
+    """Bad/clean pair for the donation pass: the same train-step shape
+    with and without ``donate_argnums``."""
+
+    @staticmethod
+    def _step(p, m, batch):
+        """Adam-shaped carried state: params + one moments tree."""
+        g = jax.tree.map(lambda w: w * 0.0 + batch.sum(), p)
+        m2 = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+        p2 = jax.tree.map(lambda w, mm: w - 0.01 * mm, p, m2)
+        return p2, m2
+
+    @staticmethod
+    def _state():
+        params = {"w": jnp.ones((64, 32), jnp.float32)}
+        moments = {"w": jnp.zeros((64, 32), jnp.float32)}
+        return params, moments, jnp.ones((4,), jnp.float32)
+
+    def test_undonated_param_sized_inputs_warned(self):
+        params, moments, batch = self._state()
+        findings = by_rule(
+            lint_fn(self._step, params, moments, batch,
+                    compile=False, params=params,
+                    shardings={"w": P()}),
+            "undonated-step-buffers",
+        )
+        warns = [f for f in findings if f.severity == Severity.WARNING]
+        assert warns, "undonated params/opt_state not flagged"
+        assert "donate_argnums" in warns[0].message
+        # both the param arg and its same-shaped moments arg count
+        assert "2 step input(s)" in warns[0].message
+
+    def test_donated_step_is_clean(self):
+        import functools
+
+        params, moments, batch = self._state()
+        step = functools.partial(jax.jit, donate_argnums=(0, 1))(
+            self._step)
+        findings = by_rule(
+            lint_fn(step, params, moments, batch,
+                    compile=False, params=params,
+                    shardings={"w": P()}),
+            "undonated-step-buffers",
+        )
+        assert findings == [], "\n".join(map(str, findings))
+
+    def test_heuristic_fires_only_on_donate_nothing_modules(self):
+        """No param_info: large undonated inputs are INFO, but only
+        when the module donates nothing at all — a module with ANY
+        donation made its decision and stays unflagged."""
+        big = jnp.ones((1024, 1024), jnp.float32)
+        opts = {"donation_min_elements": 1 << 20}
+
+        def step(p, m, batch):
+            return p - 0.01 * m, 0.9 * m + batch.sum()
+
+        findings = by_rule(
+            lint_fn(step, big, big, jnp.ones((4,), jnp.float32),
+                    compile=False, options=opts),
+            "undonated-step-buffers",
+        )
+        infos = [f for f in findings if f.severity == Severity.INFO]
+        assert infos and "no entry argument is donated" in infos[0].message
+
+        import functools
+
+        donated_one = functools.partial(
+            jax.jit, donate_argnums=(1,))(step)
+        findings = by_rule(
+            lint_fn(donated_one, big, big, jnp.ones((4,), jnp.float32),
+                    compile=False, options=opts),
+            "undonated-step-buffers",
+        )
+        assert findings == [], "\n".join(map(str, findings))
+
+    def test_inference_forward_with_params_is_silent(self):
+        """Donation needs a same-(dtype, shape) OUTPUT to alias into;
+        a pure forward returns only activations, so its params cannot
+        be donated and advising it would be cry-wolf."""
+        params, _, _ = self._state()
+
+        def forward(p, batch):
+            return batch @ p["w"]
+
+        findings = by_rule(
+            lint_fn(forward, params, jnp.ones((4, 64), jnp.float32),
+                    compile=False, params=params,
+                    shardings={"w": P()}),
+            "undonated-step-buffers",
+        )
+        assert findings == [], "\n".join(map(str, findings))
+
+    def test_adamw_counts_both_moment_trees(self):
+        """The output multiset is the donation budget: adamw carries
+        TWO param-shaped moment trees (mu and nu), and all three
+        undonated state inputs must count — a fixed params+moments
+        pair would undercount the doubled bytes by a third."""
+        import optax
+
+        from sparkdl_tpu.parallel.train import make_train_step
+
+        params = {"w": jnp.ones((64, 32), jnp.float32)}
+        opt = optax.adamw(1e-3)
+        opt_state = opt.init(params)
+        step = make_train_step(
+            lambda p, b: ((b @ p["w"]) ** 2).mean(), opt)
+        findings = by_rule(
+            lint_fn(step, params, opt_state,
+                    jnp.ones((4, 64), jnp.float32),
+                    compile=False, params=params,
+                    shardings={"w": P()}),
+            "undonated-step-buffers",
+        )
+        (warn,) = [f for f in findings
+                   if f.severity == Severity.WARNING]
+        assert "3 step input(s)" in warn.message, warn.message
+
+    def test_sharded_and_donated_arg_is_recognized_as_donated(self):
+        """MLIR prints dict attrs alphabetically, so on a GSPMD
+        program the donation attr follows an ``mhlo.sharding`` string
+        whose nested braces would truncate a naive attr-dict regex —
+        the donated arg must still parse as donated (a false WARNING
+        on correctly-donated sharded Llama steps would be the
+        cry-wolf failure mode)."""
+        from sparkdl_tpu.analysis.passes_donation import main_args
+
+        text = (
+            'func.func public @main('
+            '%arg0: tensor<4096x4096xf32> {mhlo.sharding = '
+            '"{devices=[2,1]<=[2]}", tf.aliasing_output = 0 : i32} '
+            'loc("p"), '
+            '%arg1: tensor<4096x4096xf32> {mhlo.sharding = '
+            '"{devices=[2,1]<=[2]}"} loc("m"), '
+            '%arg2: tensor<8x128xi32>) '
+            '-> (tensor<4096x4096xf32>) {'
+        )
+        args = main_args(text)
+        assert args == [
+            (0, (4096, 4096), "float32", "alias"),
+            (1, (4096, 4096), "float32", None),
+            (2, (8, 128), "int32", None),
+        ]
+
+    def test_unaliased_buffer_donor_does_not_shrink_the_budget(self):
+        """jax.buffer_donor args are donated but alias no output, so
+        they must not consume an output slot — otherwise the two
+        undonated state inputs here would be undercounted as one."""
+        from sparkdl_tpu.analysis.core import GraphContext, ParamInfo
+        from sparkdl_tpu.analysis.passes_donation import (
+            undonated_step_buffers,
+        )
+
+        text = (
+            'func.func public @main('
+            '%arg0: tensor<64x32xf32> {jax.buffer_donor = true}, '
+            '%arg1: tensor<64x32xf32>, '
+            '%arg2: tensor<64x32xf32>, '
+            '%arg3: tensor<4x64xf32>) '
+            '-> (tensor<64x32xf32>, tensor<64x32xf32>) {'
+        )
+        ctx = GraphContext(
+            stablehlo_text=text,
+            param_info=[ParamInfo(
+                path="['w']", shape=(64, 32), dtype="float32",
+                sharded_axes=())],
+        )
+        (warn,) = undonated_step_buffers(ctx)
+        assert "2 step input(s)" in warn.message, warn.message
+
+    def test_small_undonated_inputs_stay_silent(self):
+        """The clean-mnist acceptance bar in miniature: small tensors
+        never trip the heuristic."""
+
+        def step(p, batch):
+            return p + batch.sum()
+
+        findings = by_rule(
+            lint_fn(step, jnp.ones((8, 8)), jnp.ones((4,)),
+                    compile=False),
+            "undonated-step-buffers",
+        )
+        assert findings == [], "\n".join(map(str, findings))

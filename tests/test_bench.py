@@ -41,6 +41,29 @@ def test_bench_emits_single_json_line_on_cpu():
     assert out["vs_baseline"] is not None
     assert 0 <= out["mfu"] < 1
     assert out["platform"] == "cpu"
+    # warm-start compilation fields (docs/performance.rst): wall time
+    # to a ready executable, and whether the AOT cache served it
+    assert out["compile_seconds"] >= 0
+    assert out["warm_start"] in (True, False)
+
+
+@pytest.mark.gang
+@pytest.mark.slow   # two full bench subprocesses — outside the tier-1 box
+def test_bench_second_run_warm_starts(tmp_path):
+    """Two bench runs against one compile-cache dir: the rerun (the
+    probe-retry scenario) must deserialize instead of recompiling —
+    warm_start flips true and the executable-ready time collapses."""
+    env = {
+        "SPARKDL_TPU_BENCH_PLATFORM": "cpu",
+        "SPARKDL_TPU_BENCH_TINY": "1",
+        "SPARKDL_TPU_COMPILE_CACHE_DIR": str(tmp_path / "cc"),
+    }
+    cold = json.loads(_run(env).stdout.strip().splitlines()[-1])
+    warm = json.loads(_run(env).stdout.strip().splitlines()[-1])
+    assert cold["warm_start"] is False
+    assert warm["warm_start"] is True
+    assert warm["compile_seconds"] < cold["compile_seconds"]
+    assert warm["last_loss"] == cold["last_loss"]  # same executable
 
 
 def _load_bench():

@@ -63,6 +63,34 @@ def test_async_checkpoint_roundtrip(tmp_path):
         ckpt.close()
 
 
+def test_async_save_then_immediate_close_commits_the_step(tmp_path):
+    """close() must join the in-flight async write before disposing
+    the manager: the run's FINAL checkpoint is the one a resume needs,
+    and tearing the writer down mid-flight leaves only a temp dir
+    where the committed (numeric-named) step should be."""
+    import os
+
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.utils.checkpoint import (
+        TrainCheckpointer,
+        latest_complete_step,
+    )
+
+    root = tmp_path / "final"
+    ckpt = TrainCheckpointer(str(root), async_save=True)
+    assert ckpt.save(5, {"w": jnp.arange(4.0)})
+    ckpt.close()   # no wait_until_finished() in between — the bug path
+
+    # committed = a bare numeric dir (orbax's rename-commit protocol);
+    # latest_complete_step is the supervisor's resume scan
+    assert latest_complete_step(str(root)) == 5
+    names = sorted(os.listdir(root))
+    assert "5" in names
+    assert not [n for n in names if not n.isdigit()], (
+        f"uncommitted temp dirs left behind: {names}")
+
+
 def test_checkpoint_regime_decided_at_first_use_not_construction(
         tmp_path, monkeypatch):
     """ADVICE r3: a checkpointer constructed BEFORE hvd.init() in a
