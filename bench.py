@@ -26,7 +26,12 @@ cache and serves the measured program through
 under the system tempdir), so a probe-retry rerun deserializes the step
 executable instead of burning its timeout budget on a recompile. The
 JSON line carries ``compile_seconds`` (wall time to a ready
-executable) and ``warm_start`` (True when it came from the AOT cache).
+executable) and ``warm_start`` (True when it came from the AOT cache),
+plus ``steps_per_sec_p50``/``steps_per_sec_p99`` (rate distribution
+over repeated invocations of the measured executable; p99 is the slow
+tail) and ``hbm_high_water_bytes`` (peak device memory from the same
+``observe.health`` gauge exporter the gang heartbeat uses; null on
+deviceless hosts).
 
 ORDERING CONTRACT (the bench gate's hard-earned rule): run this bench
 **before** the tier-1 pytest suite on an accelerator host — ``make
@@ -411,6 +416,37 @@ def run():
 
     tokens_per_sec = n_steps * batch * seq / dt
 
+    # Steps/sec distribution + HBM high-water (ISSUE: observability).
+    # A few more timed invocations of the SAME measured executable
+    # give a steps/sec sample set (p50/p99 expose jitter a single
+    # headline number hides — a noisy neighbor, a thermal throttle);
+    # the memory gauge comes from observe.health.export_device_memory,
+    # the exact helper each gang worker's heartbeat exports
+    # device_hbm_bytes{kind=} from, so the bench's high-water and a
+    # live gang's agree by construction. Null on deviceless hosts —
+    # a CPU rig has no HBM to report.
+    rates = [n_steps / dt]
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, opt_state, last = run_n(params, opt_state, batch_data)
+        _ = float(np.asarray(last))
+        rates.append(n_steps / (time.perf_counter() - t0))
+    # p99 is the SLOW tail (the rate at the 99th percentile of step
+    # latency — reciprocal is monotonic, so that's the 1st percentile
+    # of the rate samples): p99 <= p50 by construction.
+    steps_per_sec_p50 = float(np.percentile(rates, 50))
+    steps_per_sec_p99 = float(np.percentile(rates, 1))
+
+    from sparkdl_tpu.observe.health import export_device_memory
+    from sparkdl_tpu.observe.metrics import Registry
+
+    hbm = export_device_memory(Registry())
+    hbm_high_water = (
+        int(hbm["peak"])
+        if jax.devices()[0].platform != "cpu" and "peak" in hbm
+        else None
+    )
+
     # Model FLOPs/token (matmul terms only, causal attention halved):
     #   forward        2N        (N = non-embedding matmul params)
     #   backward dX    2N        (chain rule through frozen weights)
@@ -443,6 +479,9 @@ def run():
         "last_loss": round(last_loss, 4),
         "compile_seconds": round(compile_seconds, 3),
         "warm_start": warm_start,
+        "steps_per_sec_p50": round(steps_per_sec_p50, 3),
+        "steps_per_sec_p99": round(steps_per_sec_p99, 3),
+        "hbm_high_water_bytes": hbm_high_water,
         **({"promoted": promoted} if promoted else {}),
     }))
 

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""CI hang-detection smoke (ISSUE 5: observability): boot a 2-rank
+gang with a chaos stall injected inside a step and FAIL the build
+unless the whole gang-health pipeline fires: the driver declares
+stall → hang within the deadline, the stalled rank's faulthandler
+stack dump lands in the run dir, the supervisor relaunches under the
+HANG cause and the job completes from checkpoint, and
+``observe.doctor`` reproduces the hang verdict from the artifacts
+alone with a nonzero exit. The run dir (doctor report included) is
+uploaded by the workflow so a red build's postmortem is one click
+away.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/hang_smoke.py``
+(defaults the dir to ``./hang-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Runnable as `python ci/hang_smoke.py` from a checkout: the script
+# dir (ci/) is sys.path[0], the package root is one up.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Detection deadline for the WHOLE story (inject → verdicts → dump →
+# relaunch → resumed completion). Stall window is 8s (must exceed the
+# first collective's gloo-connect + compile); everything else is
+# seconds.
+DEADLINE_S = 300
+
+
+def _ckpt_main(ckpt_dir, total_steps):
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.parallel.train import instrument_step
+    from sparkdl_tpu.utils.chaos import chaos_step
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    hvd.init()
+    ctx = restart_context()
+    ckpt = TrainCheckpointer(ckpt_dir)
+    w = np.zeros((4,), np.float32)
+    start = 0
+    if ctx.resume_step is not None:
+        restored = ckpt.restore(
+            ctx.resume_step, target={"w": np.zeros((4,), np.float32)})
+        w = np.asarray(restored["w"])
+        start = ctx.resume_step + 1
+
+    def one_step(step, w):
+        g = hvd.allreduce(
+            np.full((4,), float((hvd.rank() + 1) * (step + 1)),
+                    np.float32), op=hvd.Sum)
+        return (w - 0.01 * np.asarray(g)).astype(np.float32)
+
+    stepped = instrument_step(one_step)
+    try:
+        for step in range(start, total_steps):
+            w = stepped(step, w)
+            ckpt.save(step, {"w": w})
+            ckpt.wait_until_finished()
+            hvd.barrier()
+            chaos_step(step)
+    finally:
+        ckpt.close()
+    return {"attempt": ctx.attempt}
+
+
+def fail(msg):
+    print(f"HANG SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "hang-artifacts"),
+    )
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    ck = os.path.join(out_dir, "ck")
+    env = {
+        "SPARKDL_TPU_GANG_MAX_RETRIES": "2",
+        "SPARKDL_TPU_GANG_BACKOFF_BASE": "0.2",
+        "SPARKDL_TPU_GANG_BACKOFF_MAX": "0.5",
+        "SPARKDL_TPU_GANG_RESUME_DIR": ck,
+        "SPARKDL_TPU_ABORT_GRACE": "10",
+        "SPARKDL_TPU_HEARTBEAT_S": "0.2",
+        "SPARKDL_TPU_STALL_S": "8",
+        "SPARKDL_TPU_DUMP_GRACE": "10",
+        "SPARKDL_TPU_CHAOS_STALL_STEP": "2",
+        "SPARKDL_TPU_CHAOS_STALL_STEP_RANK": "1",
+        "SPARKDL_TPU_CHAOS_ONCE_FILE": os.path.join(
+            out_dir, "one-stall"),
+    }
+    os.environ.update(env)
+
+    from sparkdl import HorovodRunner
+
+    t0 = time.monotonic()
+    result = HorovodRunner(np=-2).run(_ckpt_main, ckpt_dir=ck,
+                                      total_steps=4)
+    elapsed = time.monotonic() - t0
+    print(f"gang result: {result} ({elapsed:.1f}s)")
+    if elapsed > DEADLINE_S:
+        fail(f"detection + relaunch took {elapsed:.0f}s "
+             f"(deadline {DEADLINE_S}s)")
+    if result["attempt"] != 1:
+        fail(f"expected exactly one supervised relaunch, got attempt "
+             f"{result['attempt']}")
+
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected one run dir under {out_dir}, found {run_dirs}")
+    run = run_dirs[0]
+
+    # detection fired: stall then hang verdicts on the driver lane
+    try:
+        with open(os.path.join(run, "timeline.json")) as f:
+            events = [e for e in json.load(f)["traceEvents"]
+                      if e.get("ph") != "M"]
+    except (OSError, ValueError, KeyError) as e:
+        fail(f"timeline.json missing or malformed: {e}")
+    names = [e.get("name") for e in events]
+    for required in ("chaos.stall_in_step", "health.stall",
+                     "health.hang", "health.stack_dump"):
+        if required not in names:
+            fail(f"timeline missing {required!r} (have {sorted(set(names))})")
+    stall_ts = min(e["ts"] for e in events
+                   if e["name"] == "health.stall")
+    hang_ts = min(e["ts"] for e in events if e["name"] == "health.hang")
+    if not stall_ts <= hang_ts:
+        fail("stall verdict did not precede the hang verdict")
+
+    # the supervisor relaunched under the HANG cause
+    causes = [e["args"].get("cause", "") for e in events
+              if e.get("name") == "gang.failure"]
+    if not any("HANG" in c for c in causes):
+        fail(f"no gang.failure with a HANG cause (causes: {causes})")
+
+    # the stalled rank's stack dump landed, naming the wedged frame
+    dump_path = os.path.join(run, "stack-rank-1.txt")
+    if not os.path.exists(dump_path):
+        fail("stack-rank-1.txt missing from the run dir")
+    if "_stall_in_step" not in open(dump_path).read():
+        fail("stack dump does not name the stalled frame")
+
+    # the SIGKILLed rank's flight-recorder tail was recovered
+    rec_path = os.path.join(run, "flightrec-rank-1.json")
+    if not os.path.exists(rec_path):
+        fail("flightrec-rank-1.json missing from the run dir")
+
+    # observe.doctor reproduces the verdict offline, exit nonzero
+    doctor_env = dict(os.environ)
+    doctor_env["PYTHONPATH"] = (
+        REPO + os.pathsep + doctor_env.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run],
+        capture_output=True, text=True, timeout=120, env=doctor_env,
+    )
+    if r.returncode != 1:
+        fail(f"doctor exit {r.returncode} (expected 1 for a hang); "
+             f"stderr: {r.stderr[-400:]}")
+    if "HANG" not in r.stdout:
+        fail(f"doctor report names no hang:\n{r.stdout}")
+    report_path = os.path.join(run, "doctor-report.txt")
+    with open(report_path, "w") as f:
+        f.write(r.stdout)
+    print(r.stdout)
+    print(f"HANG SMOKE OK: verdicts + dump + relaunch + doctor under "
+          f"{run}")
+
+
+if __name__ == "__main__":
+    main()

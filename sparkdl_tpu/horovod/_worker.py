@@ -102,14 +102,43 @@ def worker_io(rank, local_log_path=None):
     if client is not None:
         # Fail-fast failure detection in BOTH directions: the launcher
         # reaps dead workers; this reaps workers whose DRIVER died
-        # (even via SIGKILL) so orphans never pin chips or leases.
+        # (even via SIGKILL) so orphans never pin chips or leases —
+        # and the same watchdog thread answers the driver's
+        # hang-diagnosis DUMP_REQ frames with faulthandler stacks.
         client.start_driver_watchdog()
+    heartbeat = None
+    flightrec = None
     if client is not None and observe.enabled():
         # Telemetry transport: periodic batched flushes of this
         # worker's metric snapshot + timeline events over the control
         # plane (TELEMETRY frames), merged gang-wide on the driver.
         observe.set_sink(client.send_telemetry)
         observe.start_flusher()
+        # Flight recorder: mirror every timeline event into an
+        # mmap-backed ring in the job dir so the tail survives a
+        # SIGKILL between flushes (the driver recovers it into the
+        # merged run dir). Job-dir-less backends (Spark barrier
+        # tasks) skip it — there is no shared dir to recover from.
+        job_dir = os.environ.get("SPARKDL_TPU_JOB_DIR")
+        if job_dir:
+            from sparkdl_tpu.observe.flightrec import (
+                FlightRecorder,
+                ring_path,
+            )
+
+            try:
+                flightrec = FlightRecorder(ring_path(job_dir, rank))
+                observe.set_flight_recorder(flightrec)
+            except OSError:
+                flightrec = None  # unwritable dir: telemetry still works
+        # Gang health: liveness beacons on the guaranteed control
+        # socket — they keep flowing while the training thread is
+        # wedged, which is what lets the driver tell a hang from a
+        # long step (sparkdl_tpu.observe.health).
+        from sparkdl_tpu.observe.health import HeartbeatSender
+
+        heartbeat = HeartbeatSender(client, rank)
+        heartbeat.start()
         observe.instant("worker.start", cat="worker", rank=rank)
     _set_parent_death_signal()
     local_log = (
@@ -140,6 +169,8 @@ def worker_io(rank, local_log_path=None):
         sys.stdout, sys.stderr = orig_stdout, orig_stderr
         if client is not None:
             if observe.enabled():
+                if heartbeat is not None:
+                    heartbeat.stop()
                 # Final flush BEFORE the BYE: the driver treats BYE as
                 # this rank's last word, and the tail of the timeline
                 # (checkpoint saves, the last step spans) must not
@@ -149,6 +180,9 @@ def worker_io(rank, local_log_path=None):
                 observe.stop_flusher()
                 observe.flush()
                 observe.set_sink(None)
+                if flightrec is not None:
+                    observe.set_flight_recorder(None)
+                    flightrec.close()
             client.send_bye(exit_code)
             client.close()
         local_log.close()

@@ -43,6 +43,9 @@ MSG_AUTH = 7
 MSG_RESULT_PART = 8   # chunk of an oversized RESULT (rank 0 only)
 MSG_RESULT_END = 9    # terminates a chunked RESULT
 MSG_TELEMETRY = 10    # observe: batched metric snapshot + timeline events
+MSG_HEARTBEAT = 11    # observe.health: per-rank liveness beacon
+MSG_DUMP_REQ = 12     # driver→worker: send an all-thread stack dump
+MSG_STACK_DUMP = 13   # worker→driver: the faulthandler dump text
 
 _HEADER = struct.Struct(">IBI")  # length (of type+rank+payload), type, rank
 
@@ -52,7 +55,8 @@ _MSG_NAMES = {
     MSG_READY: "READY", MSG_LOG: "LOG", MSG_USERLOG: "USERLOG",
     MSG_RESULT: "RESULT", MSG_EXC: "EXC", MSG_BYE: "BYE",
     MSG_AUTH: "AUTH", MSG_RESULT_PART: "RESULT", MSG_RESULT_END: "RESULT",
-    MSG_TELEMETRY: "TELEMETRY",
+    MSG_TELEMETRY: "TELEMETRY", MSG_HEARTBEAT: "HEARTBEAT",
+    MSG_STACK_DUMP: "STACK_DUMP",
 }
 
 CONTROL_ADDR_ENV = "SPARKDL_TPU_CONTROL_ADDR"
@@ -143,13 +147,24 @@ class ControlPlaneServer:
 
     def __init__(self, num_workers, verbosity="log_callback_only", log_path=None,
                  bind_host="127.0.0.1", advertise_host=None, secret=None,
-                 telemetry=None):
+                 telemetry=None, health=None):
         self.num_workers = num_workers
         self.verbosity = verbosity
         # Optional observability sink (sparkdl_tpu.observe.aggregate.
         # GangTelemetry): TELEMETRY frames are decoded and handed to
         # it; without one they are dropped (telemetry is opt-in).
         self._telemetry = telemetry
+        # Optional hang detector (sparkdl_tpu.observe.health.
+        # HangDetector): HEARTBEAT frames feed it; without one they
+        # are dropped (health is part of the same telemetry opt-in).
+        self._health = health
+        # rank -> the connection carrying that rank's GUARANTEED
+        # control socket (recorded on READY/HEARTBEAT — the native log
+        # sender's extra connections only ever carry LOG and have no
+        # reader on the worker side, so a driver→worker dump request
+        # must ride the main socket the watchdog reads).
+        self._conns = {}
+        self._stack_dumps = {}  # rank -> [dump text, ...]
         # Per-job shared secret; the launcher ships it to workers via
         # CONTROL_SECRET_ENV. Auto-generated so no caller can forget it.
         self.secret = secret or _secrets.token_hex(32)
@@ -261,6 +276,13 @@ class ControlPlaneServer:
                         f"claiming rank {rank}; closing"
                     )
                     return
+                if mtype in (MSG_READY, MSG_HEARTBEAT):
+                    # This connection is the rank's guaranteed control
+                    # socket (its worker runs the watchdog reader on
+                    # it) — the channel driver→worker dump requests
+                    # ride. Native log connections never send these.
+                    with self._lock:
+                        self._conns[rank] = conn
                 try:
                     self._handle(mtype, rank, payload)
                 except Exception:
@@ -351,6 +373,27 @@ class ControlPlaneServer:
                 self._telemetry.ingest(
                     rank, json.loads(payload.decode("utf-8", "replace"))
                 )
+        elif mtype == MSG_HEARTBEAT:
+            if self._health is not None:
+                self._health.observe_beat(
+                    rank, json.loads(payload.decode("utf-8", "replace"))
+                )
+        elif mtype == MSG_STACK_DUMP:
+            msg = json.loads(payload.decode("utf-8", "replace"))
+            dump = str(msg.get("dump", ""))
+            with self._lock:
+                self._stack_dumps.setdefault(rank, []).append(dump)
+                if self._log_file is not None:
+                    self._log_file.write(
+                        f"[rank {rank} STACK DUMP "
+                        f"({msg.get('reason', 'requested')})]\n{dump}\n"
+                    )
+            if self._telemetry is not None:
+                self._telemetry.add_stack_dump(
+                    rank, dump, reason=msg.get("reason")
+                )
+            if self._health is not None:
+                self._health.note_stack_dump(rank)
         elif mtype == MSG_EXC:
             msg = json.loads(payload.decode("utf-8", "replace"))
             with self._lock:
@@ -391,6 +434,33 @@ class ControlPlaneServer:
         deadline = time.monotonic() + timeout
         for t in list(self._threads):
             t.join(max(0.0, deadline - time.monotonic()))
+
+    def request_dump(self, rank, reason="stall"):
+        """Ask ``rank`` for an all-thread stack dump (hang/straggler
+        diagnosis). Sent down the rank's guaranteed control socket,
+        where the worker's driver-watchdog reader answers with a
+        ``STACK_DUMP`` frame. Returns False when the rank has no
+        registered connection (never beat/READY'd) or the send fails —
+        a diagnosis request must never raise into the monitor loop."""
+        with self._lock:
+            conn = self._conns.get(rank)
+        if conn is None:
+            return False
+        payload = json.dumps({"reason": reason}).encode("utf-8")
+        frame = _HEADER.pack(len(payload) + 5, MSG_DUMP_REQ, rank) + payload
+        try:
+            conn.sendall(frame)
+        except OSError:
+            return False
+        return True
+
+    def stack_dumps(self, rank=None):
+        """Collected stack-dump texts: ``{rank: [dump, ...]}``, or the
+        list for one rank."""
+        with self._lock:
+            if rank is not None:
+                return list(self._stack_dumps.get(rank, ()))
+            return {r: list(d) for r, d in self._stack_dumps.items()}
 
     def ready_count(self):
         with self._lock:
@@ -528,6 +598,14 @@ class ControlPlaneClient:
         # hot the instrumented paths run.
         self._send_json(MSG_TELEMETRY, payload_obj)
 
+    def send_heartbeat(self, payload_obj):
+        # Gang-health beacon (sparkdl_tpu.observe.health): tiny JSON at
+        # SPARKDL_TPU_HEARTBEAT_S rate on the guaranteed control
+        # socket — the whole point is that it keeps flowing while the
+        # training thread is wedged, so it must never ride the
+        # droppable native ring.
+        self._send_json(MSG_HEARTBEAT, payload_obj)
+
     def send_result(self, pickled_bytes):
         # One frame when it fits; otherwise chunk under the frame cap
         # (large returned values — e.g. model weights — are legitimate,
@@ -553,24 +631,60 @@ class ControlPlaneClient:
             self._native.flush(timeout_ms=5000)
         self._send_json(MSG_BYE, {"exit_code": exit_code})
 
-    def start_driver_watchdog(self, grace_seconds=10.0):
-        """Exit this worker when the driver disappears.
+    def _answer_dump_request(self, payload):
+        """Ship a faulthandler all-thread stack dump back to the
+        driver. Runs on the WATCHDOG thread — which is exactly why it
+        works: the training thread may be wedged in a collective or a
+        host callback, and faulthandler reads every thread's frames
+        without needing any of them to cooperate."""
+        try:
+            reason = json.loads(payload.decode("utf-8", "replace")).get(
+                "reason", "requested")
+        except ValueError:
+            reason = "requested"
+        from sparkdl_tpu.observe.health import dump_all_threads
 
-        The driver never writes on the control socket, so a blocking
-        ``recv`` returns only on EOF/reset — i.e. the driver process
-        died (including SIGKILL, which the launcher's reaper can't
-        mitigate). Orphaned workers would otherwise run forever,
-        holding devices and distributed-runtime leases (observed: a
-        killed driver left gang workers pinning the TPU claim).
+        try:
+            dump = dump_all_threads()
+        except Exception:
+            import traceback
+
+            dump = ("<faulthandler dump failed>\n"
+                    + traceback.format_exc())
+        self._send_json(MSG_STACK_DUMP, {"reason": reason, "dump": dump})
+
+    def start_driver_watchdog(self, grace_seconds=10.0):
+        """Exit this worker when the driver disappears; answer its
+        hang-diagnosis requests meanwhile.
+
+        The only driver→worker traffic is the occasional framed
+        ``DUMP_REQ`` (the hang detector asking a stalled rank for its
+        stacks), so the watchdog reads frames: a complete frame is
+        dispatched, EOF/reset means the driver process died (including
+        SIGKILL, which the launcher's reaper can't mitigate). Orphaned
+        workers would otherwise run forever, holding devices and
+        distributed-runtime leases (observed: a killed driver left
+        gang workers pinning the TPU claim).
         """
 
         def watch():
-            try:
-                data = self._sock.recv(1)
-            except OSError:
-                data = b""
-            if data:
-                return  # protocol violation; driver is alive though
+            while True:
+                try:
+                    head = _recv_exact(self._sock, _HEADER.size)
+                    if head is not None:
+                        length, mtype, _rank = _HEADER.unpack(head)
+                        if 5 <= length and length - 5 <= MAX_FRAME:
+                            payload = _recv_exact(self._sock, length - 5)
+                            if payload is not None:
+                                if mtype == MSG_DUMP_REQ:
+                                    self._answer_dump_request(payload)
+                                continue  # keep watching
+                        # unframeable driver bytes: treat like a reset
+                    head = None
+                except OSError:
+                    head = None
+                if head is None:
+                    break
             if self._closing:
                 # Our own close() raced the recv — normal teardown of a
                 # finished worker, NOT a dead driver.

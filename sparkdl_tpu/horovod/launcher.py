@@ -598,6 +598,17 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     # Local mode (np<-1) deliberately skips this: oversubscription is
     # allowed there. ONE try/finally owns every resource from here —
     # a leaked claim counts as busy for this driver's whole lifetime.
+    # Gang health (same opt-in as telemetry): the detector consumes
+    # HEARTBEAT frames on the control plane and declares stall/hang
+    # verdicts; the monitor loop below acts on them — stack dumps from
+    # stalled ranks, then a kind="hang" failure the supervisor
+    # classifies as the transient HANG cause.
+    detector = None
+    if telemetry is not None:
+        from sparkdl_tpu.observe.health import HangDetector
+
+        detector = HangDetector(num_workers)
+
     slot_claim = None
     if mode == "cluster":
         with observe.span("gang.slot_claim", cat="launch",
@@ -620,6 +631,11 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     boot_paths = {}  # payload path -> staged secret+payload boot file
     try:
         job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-job-")
+        if telemetry is not None:
+            # Flight-recorder recovery root: rank rings live in the
+            # attempt's job dir, and the merged run dir must include
+            # their tails even for ranks SIGKILLed mid-flush.
+            telemetry.note_job_dir(job_dir)
         payload_paths = []
         for r in range(num_workers):
             rank_kwargs = dict(kwargs)
@@ -669,6 +685,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             # advertise a routable address.
             bind_host="0.0.0.0" if remote_hosts else "127.0.0.1",
             telemetry=telemetry,
+            health=detector,
         )
         # jax.distributed's coordinator lives in RANK 0, so the
         # rendezvous address must name rank 0's host, reachable from
@@ -860,6 +877,47 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         first_death = None
         while any(p.poll() is None for p in procs):
             codes = [p.poll() for p in procs]
+            if detector is not None and first_death is None:
+                report = detector.poll()
+                for r in report["new_stalled"]:
+                    # Diagnose while the evidence is live: the stalled
+                    # rank's watchdog thread answers with faulthandler
+                    # stacks even though its training thread is wedged.
+                    server.request_dump(r, reason="stall")
+                if report["hang"]:
+                    verdict = report["hang"]
+                    stalled = detector.stalled_ranks
+                    # Final dump sweep over every rank still holding a
+                    # control socket (peers' stacks show WHICH
+                    # collective the gang is wedged in), then a
+                    # bounded wait for the stalled ranks' answers —
+                    # the kill below destroys the evidence.
+                    for r in range(num_workers):
+                        server.request_dump(r, reason=f"hang:{verdict}")
+                    dump_grace = float(os.environ.get(
+                        "SPARKDL_TPU_DUMP_GRACE", "10"))
+                    dump_deadline = time.monotonic() + dump_grace
+                    while time.monotonic() < dump_deadline and not all(
+                            server.stack_dumps(r) for r in stalled):
+                        time.sleep(0.1)
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    for p in procs:
+                        p.wait()
+                    raise GangFailure(
+                        "HorovodRunner gang hung: beats continued but "
+                        f"no rank made progress for "
+                        f"{detector.stall_s:.0f}s "
+                        f"(verdict: {verdict}; stalled rank(s) "
+                        f"{stalled}).\n{detector.describe()}\n"
+                        f"Stack dumps captured from rank(s) "
+                        f"{sorted(server.stack_dumps())}. "
+                        f"Worker logs: {job_dir}",
+                        kind="hang", hang_verdict=verdict,
+                        exit_codes=[p.poll() or 0 for p in procs],
+                        exceptions=server.exceptions,
+                    )
             if any(c not in (None, 0) for c in codes):
                 if first_death is None:
                     first_death = time.monotonic()
@@ -905,6 +963,11 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             )
         return cloudpickle.loads(result_bytes)
     finally:
+        if detector is not None and telemetry is not None:
+            # However this attempt ended, its detector state (per-rank
+            # last beat/step/collective, any verdicts) goes into the
+            # merged health.json — the doctor's primary evidence.
+            telemetry.add_health_summary(detector.summary())
         for bp in boot_paths.values():
             # spawned children hold their own fds; the secret-bearing
             # file must not persist in the postmortem-kept job_dir

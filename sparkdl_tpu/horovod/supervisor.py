@@ -113,17 +113,20 @@ TRANSIENT_SIGNATURES = (
 class GangFailure(RuntimeError):
     """A launched gang failed. Carries the structured evidence the
     supervisor classifies on: ``kind`` (``"rendezvous_timeout"``,
-    ``"worker_death"``, ``"start_failure"``, ``"no_result"``),
-    per-rank ``exit_codes`` (negative = killed by that signal), and
-    ``exceptions`` (rank → traceback text from EXC frames). Subclasses
-    RuntimeError so pre-supervisor callers keep working."""
+    ``"worker_death"``, ``"start_failure"``, ``"no_result"``,
+    ``"hang"``), per-rank ``exit_codes`` (negative = killed by that
+    signal), ``exceptions`` (rank → traceback text from EXC frames),
+    and for hangs the detector's ``hang_verdict``
+    (``straggler``/``deadlock``). Subclasses RuntimeError so
+    pre-supervisor callers keep working."""
 
     def __init__(self, message, *, kind="unknown", exit_codes=None,
-                 exceptions=None):
+                 exceptions=None, hang_verdict=None):
         super().__init__(message)
         self.kind = kind
         self.exit_codes = list(exit_codes or [])
         self.exceptions = dict(exceptions or {})
+        self.hang_verdict = hang_verdict
 
 
 @dataclasses.dataclass
@@ -209,8 +212,9 @@ def classify_failure(exc):
       permanent: user code raised, and rerunning user bugs burns pod
       hours to reproduce them.
     - Rendezvous timeouts, lost results, ranks killed by signals
-      (SIGKILL is what preemption looks like from the driver), and
-      infrastructure-only EXC frames → transient.
+      (SIGKILL is what preemption looks like from the driver),
+      detector-declared gang hangs (``kind="hang"`` — the HANG
+      cause), and infrastructure-only EXC frames → transient.
     - Anything else (e.g. a worker exiting 1 with no traceback — a
       bootstrap crash such as an import error) → permanent: retrying
       what we cannot name would hide real breakage.
@@ -230,6 +234,21 @@ def classify_failure(exc):
     if isinstance(exc, (ValueError, TypeError)):
         return PERMANENT, f"bad arguments ({type(exc).__name__})"
     if isinstance(exc, GangFailure):
+        if exc.kind == "hang":
+            # The hang detector declared the gang wedged (one rank
+            # stuck in a collective, a stalled host callback...) and
+            # the launcher already captured stack dumps and killed the
+            # workers. From the outside this is preemption-shaped: the
+            # run state is intact in the checkpoint, a relaunch
+            # resumes it — classify transient under the HANG cause.
+            # Checked FIRST: the launcher's own kill makes the exit
+            # codes look signal-killed, and a mid-kill EXC frame must
+            # not re-classify a diagnosed hang as user code.
+            return TRANSIENT, (
+                f"HANG ({exc.hang_verdict or 'hung'}) — gang made no "
+                "progress; stack dumps captured, relaunching from "
+                "checkpoint"
+            )
         user_ranks = [
             r for r, tb in sorted(exc.exceptions.items())
             if not _is_infra_traceback(tb)

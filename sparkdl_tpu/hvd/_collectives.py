@@ -50,9 +50,18 @@ def _observed(op_name):
         def wrapper(self, x, *args, **kwargs):
             if not observe.enabled():
                 return fn(self, x, *args, **kwargs)
+            from sparkdl_tpu.observe import health
+
+            # Gang-health markers: the ENTRY records "last entered
+            # <op>" (the line a hang postmortem shows for a rank
+            # wedged inside this collective) and bumps the progress
+            # counter; the EXIT bumps it again so a rank merely
+            # looping fast on tiny collectives still reads as live.
+            health.note_collective(op_name)
             t0 = time.perf_counter()
             out = fn(self, x, *args, **kwargs)
             dt = time.perf_counter() - t0
+            health.note_collective(op_name, done=True)
             observe.inc("collective_ops_total", op=op_name)
             observe.inc(
                 "collective_bytes_total",

@@ -24,6 +24,11 @@ API::
       stream=true  -> text/event-stream, one ``data: {"token": t}``
       event per generated token, then ``data: {"done": ...}``.
     GET /health -> {"status": "ok", "queued": N}
+    GET /healthz -> 200 {"status": "ok", "queue_depth": N,
+                         "engine_alive": true}; 503 with
+      {"status": "unavailable", ...} when the engine loop is dead or
+      the server is shutting down (the load-balancer drain signal —
+      same lifecycle classification as the 503 request failures)
     GET /metrics -> Prometheus text format (see below)
 
 Observability: the frontend owns a
@@ -134,6 +139,27 @@ class ServingFrontend:
                     self.send_header(
                         "Content-Type",
                         "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path == "/healthz":
+                    # Liveness with the SAME lifecycle classification
+                    # the request path uses (docs/serving.rst): a dead
+                    # engine loop or a shutdown in progress answers
+                    # 503 — "drain me, retry elsewhere" — while a
+                    # healthy box answers 200. Body is JSON either
+                    # way so probes can log WHY.
+                    engine_alive = frontend._engine_thread.is_alive()
+                    shutting_down = frontend._shutdown.is_set()
+                    ok = engine_alive and not shutting_down
+                    body = json.dumps({
+                        "status": "ok" if ok else "unavailable",
+                        "queue_depth": frontend._arrivals.qsize(),
+                        "engine_alive": engine_alive,
+                    }).encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

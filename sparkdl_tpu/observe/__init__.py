@@ -52,6 +52,7 @@ __all__ = [
     "inc", "set_gauge", "observe_value", "span", "instant",
     "set_sink", "flush", "start_flusher", "stop_flusher",
     "snapshot_payload", "new_run_dir", "Registry", "Timeline",
+    "set_flight_recorder",
 ]
 
 # Latched like the chaos harness: gangs ship env at spawn, so one
@@ -157,6 +158,15 @@ def set_sink(sink):
     _sink = sink
 
 
+def set_flight_recorder(rec):
+    """Mirror every timeline event into ``rec`` (a
+    :class:`~sparkdl_tpu.observe.flightrec.FlightRecorder`) so the
+    tail of the story survives a SIGKILL between flushes. ``None``
+    unregisters (and closes nothing — the caller owns the recorder's
+    lifecycle)."""
+    _timeline.observer = rec.record if rec is not None else None
+
+
 def snapshot_payload():
     """One flush unit: host/pid attribution, the cumulative metric
     snapshot, and the timeline events drained since the last flush."""
@@ -233,10 +243,14 @@ def stop_flusher():
 
 def _reset_for_tests():
     """Fresh state: re-latch the enabled flag, empty registry and
-    timeline, no sink/flusher."""
+    timeline (dropping any flight-recorder mirror), no sink/flusher,
+    health counters zeroed."""
     global _enabled, _registry, _timeline, _sink
     stop_flusher()
     _enabled = None
     _registry = Registry()
     _timeline = Timeline()
     _sink = None
+    from sparkdl_tpu.observe import health
+
+    health._reset_for_tests()

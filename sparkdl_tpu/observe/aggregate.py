@@ -40,6 +40,7 @@ from sparkdl_tpu.observe.timeline import chrome_trace
 TIMELINE_FILE = "timeline.json"
 PROM_FILE = "metrics.prom"
 JSON_FILE = "metrics.json"
+HEALTH_FILE = "health.json"
 
 DRIVER_LABEL = "driver"
 
@@ -54,6 +55,9 @@ class GangTelemetry:
         self._snaps = {}    # (rank, pid) -> latest cumulative snapshot
         self._events = {}   # rank -> [event, ...]
         self._hosts = {}    # rank -> host
+        self._stack_dumps = {}      # rank -> [(reason, dump), ...]
+        self._job_dirs = []         # one per attempt (flight-rec scan)
+        self._health_summaries = [] # one HangDetector summary/attempt
         # The driver's global registry outlives launches (a notebook
         # driver runs many); baseline it NOW so write() reports only
         # THIS launch's driver-side movement. Worker snapshots need no
@@ -79,6 +83,30 @@ class GangTelemetry:
             host = payload.get("host")
             if host:
                 self._hosts[rank] = str(host)
+
+    def add_stack_dump(self, rank, dump, reason=None):
+        """A worker answered a hang-diagnosis dump request: keep the
+        text for the run dir (``stack-rank-<r>.txt``) — the evidence
+        ``observe.doctor`` names the stalled frame from."""
+        with self._lock:
+            self._stack_dumps.setdefault(int(rank), []).append(
+                (str(reason or "requested"), str(dump))
+            )
+
+    def note_job_dir(self, job_dir):
+        """Register one attempt's job dir so ``write`` can recover
+        flight-recorder tails from it — including from ranks that were
+        SIGKILLed before their final telemetry flush."""
+        with self._lock:
+            if job_dir and job_dir not in self._job_dirs:
+                self._job_dirs.append(job_dir)
+
+    def add_health_summary(self, summary):
+        """One attempt's :meth:`HangDetector.summary` (written to
+        ``health.json`` — what the doctor reproduces verdicts from)."""
+        if summary:
+            with self._lock:
+                self._health_summaries.append(summary)
 
     @staticmethod
     def _validate_snapshot(snap):
@@ -165,12 +193,46 @@ class GangTelemetry:
         os.makedirs(out_dir, exist_ok=True)
         labeled = self._merged(driver_snap)
         trace = self.chrome(driver_timeline.drain())
-        paths = {}
-        for name, text in (
+        files = [
             (TIMELINE_FILE, json.dumps(trace)),
             (PROM_FILE, render_prometheus(labeled)),
             (JSON_FILE, render_json(labeled, indent=2)),
-        ):
+        ]
+        with self._lock:
+            dumps = {r: list(d) for r, d in self._stack_dumps.items()}
+            job_dirs = list(self._job_dirs)
+            health = list(self._health_summaries)
+        # Stack dumps from hang diagnosis: one text file per rank (a
+        # rank dumped more than once — e.g. stall then hang — keeps
+        # every dump, separated).
+        for rank in sorted(dumps):
+            text = "\n".join(
+                f"==== stack dump (reason: {reason}) ====\n{dump}"
+                for reason, dump in dumps[rank]
+            )
+            files.append((f"stack-rank-{rank}.txt", text))
+        # Flight-recorder tails: recovered from every attempt's job
+        # dir — this is the only record of a rank SIGKILLed between
+        # telemetry flushes (chaos kills, the launcher reaping a hung
+        # gang). Recovery failures are skipped, never fatal: the main
+        # artifacts must still land.
+        from sparkdl_tpu.observe.flightrec import recover_job_dir
+
+        tails = {}
+        for job_dir in job_dirs:
+            for rank, events in recover_job_dir(job_dir).items():
+                tails.setdefault(rank, []).extend(events)
+        for rank in sorted(tails):
+            files.append((
+                f"flightrec-rank-{rank}.json",
+                json.dumps({"rank": rank, "events": tails[rank]}),
+            ))
+        if health:
+            files.append(
+                (HEALTH_FILE, json.dumps({"attempts": health}, indent=2))
+            )
+        paths = {}
+        for name, text in files:
             path = os.path.join(out_dir, name)
             tmp = path + ".tmp"
             with open(tmp, "w") as f:

@@ -1,0 +1,273 @@
+"""``observe.doctor``: one-command postmortem over a merged run dir.
+
+A hung-gang incident leaves its evidence scattered across the run
+directory the launcher wrote (``SPARKDL_TPU_TELEMETRY_DIR/run-*``):
+verdict instants in ``timeline.json``, per-rank step/HBM gauges in
+``metrics.json``/``metrics.prom``, the detector's final state in
+``health.json``, faulthandler stacks in ``stack-rank-*.txt``, and the
+flight-recorder tails of ranks that died between flushes in
+``flightrec-rank-*.json``. This module merges them into ONE diagnosis::
+
+    $ python -m sparkdl_tpu.observe.doctor /tmp/telemetry/run-1234-0
+    observe.doctor: /tmp/telemetry/run-1234-0
+    verdict: HANG (straggler)
+      rank 1: stalled @ step 1, last entered reduce
+      rank 0: progressed to step 2
+    stack dumps: rank 1 (stack-rank-1.txt)
+    supervisor: 1 relaunch(es); causes: HANG (straggler) — ...
+    ...
+
+``--format json`` emits the same diagnosis as one JSON document. The
+exit code is the alerting contract: **nonzero when a hang verdict is
+found** (CI's hang smoke asserts it), zero for a clean run, 2 when the
+directory has no readable artifacts at all.
+
+Deliberately artifact-only: no jax, no control plane, no live gang —
+the doctor must run on a laptop against a copied run dir and reproduce
+the verdict from the files alone.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.1f} {unit}" if unit != "B"
+                    else f"{int(n)} {unit}")
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def _series_by_rank(metrics_doc):
+    """rank-label -> {counters: {(name, label-items): v},
+    gauges: {...}} from metrics.json."""
+    out = {}
+    for series in (metrics_doc or {}).get("series", ()):
+        rank = series.get("labels", {}).get("rank")
+        if rank is None:
+            continue
+        ranks = out.setdefault(rank, {"counters": {}, "gauges": {}})
+        for kind in ("counters", "gauges"):
+            for s in series.get(kind, ()):
+                labels = {k: v for k, v in s.get("labels", {}).items()
+                          if k != "rank"}
+                key = (s.get("name"),
+                       tuple(sorted(labels.items())))
+                ranks[kind][key] = s.get("value")
+    return out
+
+
+def _gauge(rank_series, name, **labels):
+    return rank_series.get("gauges", {}).get(
+        (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+    )
+
+
+def diagnose(run_dir):
+    """Build the structured diagnosis dict for one run dir, or None
+    when the directory holds no recognizable artifacts."""
+    timeline = _load_json(os.path.join(run_dir, "timeline.json"))
+    metrics = _load_json(os.path.join(run_dir, "metrics.json"))
+    health = _load_json(os.path.join(run_dir, "health.json"))
+    if timeline is None and metrics is None and health is None:
+        return None
+
+    events = [e for e in (timeline or {}).get("traceEvents", ())
+              if isinstance(e, dict) and e.get("ph") != "M"]
+
+    def named(name):
+        return [e for e in events if e.get("name") == name]
+
+    # -- verdict: health.json is authoritative, the timeline's
+    # health.hang instant corroborates (either alone suffices — the
+    # doctor must reproduce the verdict from whatever survived).
+    verdict = None
+    stalled, silent = set(), set()
+    attempts = (health or {}).get("attempts", [])
+    for att in attempts:
+        if att.get("hang_verdict"):
+            verdict = att["hang_verdict"]
+        stalled.update(att.get("stalled", ()))
+        silent.update(att.get("silent", ()))
+    for ev in named("health.hang"):
+        verdict = verdict or ev.get("args", {}).get("verdict")
+        stalled.update(ev.get("args", {}).get("stalled", ()))
+        silent.update(ev.get("args", {}).get("silent", ()))
+    for ev in named("health.stall"):
+        rank = ev.get("args", {}).get("rank")
+        if rank is not None:
+            stalled.add(rank)
+    for ev in named("health.silent"):
+        rank = ev.get("args", {}).get("rank")
+        if rank is not None:
+            silent.add(rank)
+
+    # -- per-rank state: detector summaries first, gauge fallback.
+    # Source the forensics from the attempt that HUNG, not the last
+    # one — a clean resumed attempt overwrites step/collective with
+    # its own (restarted) values and would repaint the postmortem.
+    ranks = {}
+    hung_attempts = [a for a in attempts if a.get("hang_verdict")]
+    for att in (hung_attempts or attempts):
+        for rank_s, info in (att.get("ranks") or {}).items():
+            ranks[int(rank_s)] = {
+                "step": info.get("step"),
+                "collective": info.get("collective"),
+                "hbm": info.get("hbm") or {},
+            }
+        if hung_attempts:
+            break   # first hung attempt is the incident
+    by_rank = _series_by_rank(metrics)
+    for rank_label, series in by_rank.items():
+        if not rank_label.isdigit():
+            continue
+        rank = int(rank_label)
+        info = ranks.setdefault(
+            rank, {"step": None, "collective": None, "hbm": {}})
+        if info["step"] is None:
+            step = _gauge(series, "worker_step")
+            if step is not None:
+                info["step"] = int(step)
+        for kind in ("peak", "in_use", "limit", "live_buffers"):
+            v = _gauge(series, "device_hbm_bytes", kind=kind)
+            if v is not None and kind not in info["hbm"]:
+                info["hbm"][kind] = v
+
+    # -- supervisor story from the driver lane
+    failures = [
+        {"attempt": e.get("args", {}).get("attempt"),
+         "verdict": e.get("args", {}).get("verdict"),
+         "cause": e.get("args", {}).get("cause")}
+        for e in named("gang.failure")
+    ]
+    resumes = [e.get("args", {}) for e in named("gang.resume")]
+    hang_causes = [f for f in failures
+                   if "hang" in str(f.get("cause", "")).lower()]
+    if verdict is None and hang_causes:
+        # Last resort: the supervisor recorded a HANG cause even
+        # though health.json and the health.* instants were lost.
+        m = re.search(r"HANG \((\w+)\)", hang_causes[0].get("cause") or "")
+        verdict = m.group(1) if m else "hung"
+
+    stack_dumps = {
+        int(os.path.basename(p)[len("stack-rank-"):-len(".txt")]): p
+        for p in glob.glob(os.path.join(run_dir, "stack-rank-*.txt"))
+    }
+    flight = {}
+    for p in glob.glob(os.path.join(run_dir, "flightrec-rank-*.json")):
+        doc = _load_json(p)
+        if doc is not None:
+            flight[int(doc.get("rank", -1))] = len(doc.get("events", ()))
+
+    chaos = sorted({e.get("name") for e in events
+                    if e.get("cat") == "chaos"})
+
+    return {
+        "run_dir": run_dir,
+        "hang": verdict is not None,
+        "verdict": verdict,
+        "stalled_ranks": sorted(stalled),
+        "silent_ranks": sorted(silent),
+        "ranks": {str(r): ranks[r] for r in sorted(ranks)},
+        "failures": failures,
+        "resumes": resumes,
+        "stack_dumps": {str(r): os.path.basename(p)
+                        for r, p in sorted(stack_dumps.items())},
+        "flight_recorder_events": {str(r): n
+                                   for r, n in sorted(flight.items())},
+        "chaos_injections": chaos,
+    }
+
+
+def render_text(diag):
+    lines = [f"observe.doctor: {diag['run_dir']}"]
+    if diag["hang"]:
+        lines.append(f"verdict: HANG ({diag['verdict']})")
+    else:
+        lines.append("verdict: no hang found")
+    stalled = set(diag["stalled_ranks"])
+    silent = set(diag["silent_ranks"])
+    for rank_s, info in diag["ranks"].items():
+        rank = int(rank_s)
+        state = ("stalled" if rank in stalled
+                 else "silent" if rank in silent
+                 else "progressed")
+        line = f"  rank {rank}: {state}"
+        if info.get("step") is not None:
+            line += (f" @ step {info['step']}" if state == "stalled"
+                     else f" to step {info['step']}")
+        if info.get("collective"):
+            line += f", last entered {info['collective']}"
+        hbm = info.get("hbm") or {}
+        peak = hbm.get("peak", hbm.get("in_use",
+                                       hbm.get("live_buffers")))
+        if peak is not None:
+            line += f"; HBM high-water {_fmt_bytes(peak)}"
+        lines.append(line)
+    if diag["stack_dumps"]:
+        lines.append("stack dumps: " + ", ".join(
+            f"rank {r} ({name})"
+            for r, name in diag["stack_dumps"].items()))
+    if diag["flight_recorder_events"]:
+        lines.append("flight recorder tails: " + ", ".join(
+            f"rank {r} ({n} events)"
+            for r, n in diag["flight_recorder_events"].items()))
+    if diag["failures"]:
+        causes = "; ".join(
+            f"attempt {f.get('attempt')}: {f.get('verdict')} — "
+            f"{f.get('cause')}" for f in diag["failures"])
+        lines.append(f"supervisor: {len(diag['failures'])} classified "
+                     f"failure(s): {causes}")
+    if diag["resumes"]:
+        steps = ", ".join(str(r.get("resume_step")) for r in diag["resumes"])
+        lines.append(f"resumed: {len(diag['resumes'])} relaunch(es) "
+                     f"(resume step(s): {steps})")
+    if diag["chaos_injections"]:
+        lines.append("chaos injections on the timeline: "
+                     + ", ".join(diag["chaos_injections"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m sparkdl_tpu.observe.doctor",
+        description="Postmortem diagnosis over a merged telemetry run "
+                    "dir; exits nonzero when a hang verdict is found.",
+    )
+    parser.add_argument("run_dir", help="a run-* dir under "
+                        "SPARKDL_TPU_TELEMETRY_DIR (or a copy of one)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    args = parser.parse_args(argv)
+
+    diag = diagnose(args.run_dir)
+    if diag is None:
+        print(f"observe.doctor: no telemetry artifacts under "
+              f"{args.run_dir} (expected timeline.json / metrics.json "
+              f"/ health.json)", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(diag, indent=2, sort_keys=True))
+    else:
+        print(render_text(diag))
+    return 1 if diag["hang"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

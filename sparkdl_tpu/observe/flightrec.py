@@ -1,0 +1,182 @@
+"""Crash-surviving flight recorder: an mmap-backed ring of the last N
+timeline events per rank.
+
+The telemetry flusher ships events to the driver every few seconds and
+once more at exit — but a SIGKILL (real preemption, the chaos
+harness, the launcher reaping a hung gang) kills the process between
+flushes and the final flush never happens. The flight recorder closes
+that gap: every timeline event is ALSO written into a fixed-size ring
+in an ``mmap``'d file, and the kernel writes dirty ``MAP_SHARED``
+pages back regardless of how the process died — so the tail of a
+SIGKILLed rank's story is recoverable from the file afterwards
+(:meth:`FlightRecorder.read_tail`, merged into the run dir by
+``observe.aggregate``).
+
+Hot-path contract: recording is *lock-free* — no blocking between
+writer threads, no fsync, no syscalls. Each event claims a slot via a
+monotonic sequence counter (``itertools.count``: one atomic fetch-add
+under the GIL) and writes its own slot independently. A reader (or a
+write torn by the kill) sees at most one garbled slot, which fails
+JSON validation and is dropped; every completed slot is ordered by its
+embedded sequence number. Single-incarnation files: each worker
+process opens its own ``flightrec-rank-<r>.ring`` in its attempt's
+job dir (a relaunch gets a fresh job dir, so incarnations never
+overwrite each other's tails).
+
+File layout (little-endian)::
+
+    header: magic "SDTFR1\\0\\0" | u32 slot_size | u32 nslots
+    slot i: u64 seq (1-based; 0 = never written) | u32 len | payload
+"""
+
+import itertools
+import json
+import mmap
+import os
+import struct
+
+MAGIC = b"SDTFR1\x00\x00"
+_HEADER = struct.Struct("<8sII")
+_SLOT_HEAD = struct.Struct("<QI")
+
+EVENTS_ENV = "SPARKDL_TPU_FLIGHTREC_EVENTS"
+DEFAULT_EVENTS = 256
+DEFAULT_SLOT_SIZE = 1024
+
+FILE_PREFIX = "flightrec-rank-"
+FILE_SUFFIX = ".ring"
+
+
+def ring_path(job_dir, rank):
+    return os.path.join(job_dir, f"{FILE_PREFIX}{int(rank)}{FILE_SUFFIX}")
+
+
+def default_events():
+    try:
+        return max(8, int(os.environ.get(EVENTS_ENV, DEFAULT_EVENTS)))
+    except ValueError:
+        return DEFAULT_EVENTS
+
+
+class FlightRecorder:
+    """Single-process writer over one ring file."""
+
+    def __init__(self, path, nslots=None, slot_size=DEFAULT_SLOT_SIZE):
+        self.path = path
+        self.nslots = int(nslots if nslots is not None else default_events())
+        self.slot_size = int(slot_size)
+        size = _HEADER.size + self.nslots * self.slot_size
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._mm[:_HEADER.size] = _HEADER.pack(
+            MAGIC, self.slot_size, self.nslots
+        )
+        self._seq = itertools.count(1)
+        self._closed = False
+
+    def record(self, event):
+        """Write one timeline event dict. Never raises into the hot
+        path — an unserializable arg or a closed ring drops the event
+        (the in-memory timeline still has it)."""
+        if self._closed:
+            return
+        try:
+            payload = json.dumps(event, default=str).encode("utf-8")
+        except (TypeError, ValueError):
+            return
+        cap = self.slot_size - _SLOT_HEAD.size
+        if len(payload) > cap:
+            # Oversized args: keep the identity fields, drop the rest —
+            # a truncated-but-parseable record beats a dropped one.
+            slim = {k: event.get(k) for k in
+                    ("name", "cat", "ph", "ts", "dur", "tid")
+                    if k in event}
+            slim["truncated"] = True
+            payload = json.dumps(slim, default=str).encode("utf-8")[:cap]
+        seq = next(self._seq)
+        off = _HEADER.size + ((seq - 1) % self.nslots) * self.slot_size
+        mm = self._mm
+        try:
+            # Payload before the slot header: a reader that sees the
+            # new (seq, len) sees the new bytes; a kill between the
+            # two leaves a record that fails JSON validation.
+            mm[off + _SLOT_HEAD.size:off + _SLOT_HEAD.size + len(payload)] \
+                = payload
+            _SLOT_HEAD.pack_into(mm, off, seq, len(payload))
+        except (ValueError, IndexError):
+            pass  # closed underneath us
+
+    def flush(self):
+        try:
+            self._mm.flush()
+        except (OSError, ValueError):
+            pass
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.flush()
+        try:
+            self._mm.close()
+        except (OSError, ValueError):
+            pass
+
+    # -- recovery ------------------------------------------------------------
+
+    @staticmethod
+    def read_tail(path):
+        """Recover the ordered event tail from a ring file written by
+        a (possibly SIGKILLed) process. Torn or garbled slots are
+        dropped; returns events oldest-first. Raises ``ValueError`` on
+        a file that was never a flight-recorder ring."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        if len(raw) < _HEADER.size:
+            raise ValueError(f"{path}: truncated flight-recorder ring")
+        magic, slot_size, nslots = _HEADER.unpack_from(raw, 0)
+        if magic != MAGIC or slot_size <= _SLOT_HEAD.size or nslots <= 0:
+            raise ValueError(f"{path}: not a flight-recorder ring")
+        out = []
+        for i in range(nslots):
+            off = _HEADER.size + i * slot_size
+            if off + _SLOT_HEAD.size > len(raw):
+                break
+            seq, ln = _SLOT_HEAD.unpack_from(raw, off)
+            if seq == 0 or ln == 0 or ln > slot_size - _SLOT_HEAD.size:
+                continue
+            body = raw[off + _SLOT_HEAD.size:off + _SLOT_HEAD.size + ln]
+            try:
+                ev = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                continue  # torn write
+            if isinstance(ev, dict):
+                out.append((seq, ev))
+        out.sort(key=lambda t: t[0])
+        return [ev for _, ev in out]
+
+
+def recover_job_dir(job_dir):
+    """``{rank: [events...]}`` for every ring file in ``job_dir``
+    (unreadable or non-ring files are skipped — recovery is
+    postmortem code and must never fail the artifact write)."""
+    out = {}
+    try:
+        names = os.listdir(job_dir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith(FILE_PREFIX) and name.endswith(FILE_SUFFIX)):
+            continue
+        rank_s = name[len(FILE_PREFIX):-len(FILE_SUFFIX)]
+        try:
+            rank = int(rank_s)
+            events = FlightRecorder.read_tail(os.path.join(job_dir, name))
+        except (ValueError, OSError):
+            continue
+        out.setdefault(rank, []).extend(events)
+    return out

@@ -1,0 +1,460 @@
+"""Gang health: worker liveness beacons and driver-side hang detection.
+
+The single worst failure mode of a real TPU gang is the *silent hang*:
+one rank stuck in a collective the others already entered, a stalled
+host callback, a wedged data loader. No rank dies, no EXC frame is
+sent, the supervisor's transient/permanent classifier never fires —
+the driver just waits. This module makes a hung gang diagnose itself:
+
+- **Worker side**: a :class:`HeartbeatSender` thread ships a liveness
+  beacon over the control plane every ``SPARKDL_TPU_HEARTBEAT_S``
+  (default 10s): the rank's current step, a monotonic *progress*
+  counter (bumped by :func:`note_step` from ``instrument_step`` and by
+  :func:`note_collective` on every collective entry/exit), the
+  last-entered collective op, and device memory gauges
+  (:func:`sparkdl_tpu.utils.jax_compat.device_memory_stats`, exported
+  as ``device_hbm_bytes{kind=...}`` plus a ``worker_step`` gauge in
+  the process registry).
+- **Driver side**: a :class:`HangDetector` tracks per-rank last-beat
+  and last-progress. A rank whose beats continue but whose progress
+  counter hasn't moved for ``SPARKDL_TPU_STALL_S`` (default 300s) is
+  declared *stalled*; a rank whose beats stop while its process lives
+  is *silent*; when every rank is stalled-or-silent the gang is
+  declared *hung* with a ``straggler`` (ranks at different steps —
+  one laggard dragged the rest into a collective wait) or
+  ``deadlock`` (everyone wedged at the same point) verdict. Verdicts
+  land as ``health.*`` timeline instants and
+  ``gang_stalls_total{verdict=...}`` counters; the launcher then
+  requests stack dumps from the stalled ranks and fails the gang with
+  ``kind="hang"`` so the supervisor relaunches it under the HANG
+  cause (docs/fault_tolerance.rst).
+
+Zero-overhead contract: everything here is inert unless telemetry is
+opted in (``SPARKDL_TPU_TELEMETRY_DIR``). ``note_step`` /
+``note_collective`` are only reached behind the callers' cached
+``observe.enabled()`` check, the sender thread is only started by the
+worker bootstrap when telemetry is on, and the detector is only
+constructed by the launcher alongside :class:`GangTelemetry`.
+
+False-positive guard: a rank is only eligible for a *stall* verdict
+once it has reported progress at least once — an uninstrumented main
+(no ``instrument_step``, no ``hvd`` collectives) never moves the
+counter and must never be killed as "hung". Size ``STALL_S`` above
+your worst-case XLA compile: progress bumps at step *entry*, so a
+long first-step compile only pins the counter for one compile, but a
+stall window shorter than that compile would still misfire.
+"""
+
+import os
+import threading
+import time
+
+HEARTBEAT_S_ENV = "SPARKDL_TPU_HEARTBEAT_S"
+STALL_S_ENV = "SPARKDL_TPU_STALL_S"
+DEFAULT_HEARTBEAT_S = 10.0
+DEFAULT_STALL_S = 300.0
+
+# Gang-level hang verdicts (the doctor reproduces these from artifacts
+# alone, so the strings are contract).
+VERDICT_STALL = "stall"
+VERDICT_SILENT = "silent"
+VERDICT_STRAGGLER = "straggler"
+VERDICT_DEADLOCK = "deadlock"
+
+
+def heartbeat_interval():
+    return float(os.environ.get(HEARTBEAT_S_ENV, DEFAULT_HEARTBEAT_S))
+
+
+def stall_seconds():
+    return float(os.environ.get(STALL_S_ENV, DEFAULT_STALL_S))
+
+
+# -- worker-side progress state ---------------------------------------------
+#
+# One tiny shared struct per process; writers are the training thread
+# (note_step / note_collective, behind the callers' enabled() latch)
+# and the reader is the heartbeat thread. A plain lock is fine — these
+# fire at step/collective rate, not per-element.
+
+_state_lock = threading.Lock()
+_state = {"step": None, "progress": 0, "collective": None}
+
+
+def note_step(step):
+    """Training-loop progress marker (``instrument_step`` calls this
+    at step entry). Bumps the monotonic progress counter."""
+    with _state_lock:
+        _state["step"] = int(step)
+        _state["progress"] += 1
+
+
+def note_collective(op, done=False):
+    """Collective entry/exit marker (the ``hvd`` engine calls this
+    around every public op). Entering an op IS progress — a rank
+    wedged inside its first allreduce must still be stall-eligible —
+    and the entry records the op name the postmortem will show as
+    "last entered <op>"."""
+    with _state_lock:
+        if not done:
+            _state["collective"] = str(op)
+        _state["progress"] += 1
+
+
+def progress_snapshot():
+    with _state_lock:
+        return dict(_state)
+
+
+def export_device_memory(registry):
+    """Set ``device_hbm_bytes{kind=...}`` gauges on ``registry`` from
+    the jax_compat shims and return the raw dict (``{}`` when nothing
+    is readable — CPU rigs without memory_stats report live-buffer
+    bytes instead)."""
+    from sparkdl_tpu.utils import jax_compat
+
+    out = {}
+    stats = jax_compat.device_memory_stats()
+    if stats:
+        kinds = {"bytes_in_use": "in_use", "peak_bytes_in_use": "peak",
+                 "bytes_limit": "limit"}
+        for key, kind in kinds.items():
+            if key in stats:
+                out[kind] = stats[key]
+    else:
+        live = jax_compat.live_buffer_bytes()
+        if live is not None:
+            out["live_buffers"] = live
+    for kind, value in out.items():
+        registry.gauge("device_hbm_bytes", kind=kind).set(value)
+    return out
+
+
+def heartbeat_payload(rank):
+    """One liveness beacon: progress state + device memory, with the
+    ``worker_step`` / ``device_hbm_bytes`` gauges refreshed in the
+    process registry so the next telemetry flush exports them."""
+    from sparkdl_tpu import observe
+
+    snap = progress_snapshot()
+    registry = observe.metrics()
+    if snap["step"] is not None:
+        registry.gauge("worker_step").set(snap["step"])
+    registry.gauge("worker_progress").set(snap["progress"])
+    hbm = export_device_memory(registry)
+    return {
+        "rank": int(rank),
+        "step": snap["step"],
+        "progress": snap["progress"],
+        "collective": snap["collective"],
+        "hbm": hbm,
+        "ts": time.time(),
+    }
+
+
+class HeartbeatSender:
+    """Worker-side beacon thread: ships :func:`heartbeat_payload` over
+    the control plane every ``interval`` seconds (first beat
+    immediately, so the driver learns this rank's baseline before the
+    first stall window can elapse). The chaos harness can mute it
+    (``SPARKDL_TPU_CHAOS_MUTE_HEARTBEAT`` — beats stop, process
+    alive) to exercise the detector's *silent* verdict."""
+
+    def __init__(self, client, rank, interval=None):
+        self._client = client
+        self._rank = int(rank)
+        self._interval = (heartbeat_interval() if interval is None
+                          else float(interval))
+        self._stop = threading.Event()
+        self._thread = None
+
+    def beat(self):
+        from sparkdl_tpu.utils.chaos import heartbeat_muted
+
+        if heartbeat_muted(self._rank):
+            return False
+        try:
+            self._client.send_heartbeat(heartbeat_payload(self._rank))
+        except Exception:
+            # A beat must never take down the worker; the control-plane
+            # client already swallows socket errors, this guards the
+            # payload assembly (e.g. an exotic device backend).
+            return False
+        return True
+
+    def start(self):
+        if self._interval <= 0:
+            return None
+        if self._thread is not None and self._thread.is_alive():
+            return self._thread
+
+        def loop():
+            self.beat()
+            while not self._stop.wait(self._interval):
+                self.beat()
+
+        self._thread = threading.Thread(
+            target=loop, name="sparkdl-tpu-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._thread = None
+
+
+# -- driver-side detection ---------------------------------------------------
+
+
+class HangDetector:
+    """Tracks per-rank liveness from HEARTBEAT frames and declares
+    stall / silent / hang verdicts.
+
+    ``observe_beat`` is called from control-plane connection threads;
+    ``poll`` from the launcher's monitor loop (throttled internally to
+    ``check_every``). Verdict instants and counters are emitted HERE
+    (the detector only exists when telemetry is on), while the caller
+    acts on the returned report: request stack dumps for newly stalled
+    ranks, fail the gang on a hang verdict.
+    """
+
+    def __init__(self, num_workers, stall_s=None, clock=time.monotonic,
+                 check_every=1.0):
+        self.num_workers = int(num_workers)
+        self.stall_s = stall_seconds() if stall_s is None else float(stall_s)
+        self._clock = clock
+        self._check_every = float(check_every)
+        self._lock = threading.Lock()
+        self._ranks = {}       # rank -> beat/progress bookkeeping
+        self._stalled = set()  # ranks with an emitted stall verdict
+        self._silent = set()
+        self._hang_verdict = None
+        self._next_check = 0.0
+        self._t0 = None        # first poll (gang considered running)
+
+    def observe_beat(self, rank, payload):
+        from sparkdl_tpu import observe
+
+        now = self._clock()
+        rank = int(rank)
+        progress = payload.get("progress")
+        recovered = False
+        with self._lock:
+            info = self._ranks.get(rank)
+            if info is None:
+                info = self._ranks[rank] = {
+                    "progress": None, "progress_t": now,
+                    "ever_progressed": False,
+                }
+            info["last_beat"] = now
+            info["step"] = payload.get("step")
+            info["collective"] = payload.get("collective")
+            info["hbm"] = payload.get("hbm") or {}
+            if isinstance(progress, (int, float)):
+                if info["progress"] is None or progress > info["progress"]:
+                    if info["progress"] is not None and rank in self._stalled:
+                        # Progress resumed after a stall verdict (the
+                        # window was undersized, or the wedge cleared):
+                        # revoke it, or one long-ago transient stall
+                        # would let a later hang verdict condemn a
+                        # rank that is demonstrably training.
+                        self._stalled.discard(rank)
+                        recovered = True
+                    info["progress"] = progress
+                    info["progress_t"] = now
+                if progress > 0:
+                    info["ever_progressed"] = True
+            if rank in self._silent:
+                # Beats resumed (e.g. a transient network blip): the
+                # rank is observable again.
+                self._silent.discard(rank)
+        if recovered:
+            observe.instant("health.recovered", cat="health", rank=rank,
+                            progress=progress)
+
+    # -- verdict machinery ---------------------------------------------------
+
+    def _classify_locked(self, now):
+        """(newly_stalled, newly_silent, hang_verdict_or_None)."""
+        new_stalled, new_silent = [], []
+        # Judge every EXPECTED rank, not just the observed ones: a
+        # rank whose beacon never arrived at all (muted from boot, a
+        # dead heartbeat thread, dropped frames) must become *silent*
+        # once the gang has been running a full window — otherwise it
+        # would both escape its own verdict and veto the gang's.
+        expected = set(range(self.num_workers)) | set(self._ranks)
+        for rank in expected:
+            info = self._ranks.get(rank)
+            if info is None:
+                if (self._t0 is not None
+                        and now - self._t0 > self.stall_s
+                        and rank not in self._silent):
+                    new_silent.append(rank)
+                continue
+            beat_age = now - info["last_beat"]
+            if beat_age > self.stall_s:
+                if rank not in self._silent:
+                    new_silent.append(rank)
+                continue
+            # Beats continue: stall = no progress movement for the
+            # whole window, on a rank that has proven it CAN progress
+            # (uninstrumented mains never become stall-eligible).
+            if (info["ever_progressed"]
+                    and now - info["progress_t"] > self.stall_s
+                    and rank not in self._stalled):
+                new_stalled.append(rank)
+        # Gang hang: every expected rank is beating-but-stalled or
+        # silent (and at least one is genuinely stalled — an all-silent
+        # gang is a dead control plane, not a hang).
+        hang = None
+        if self._hang_verdict is None and expected:
+            stalled_after = self._stalled | set(new_stalled)
+            silent_after = self._silent | set(new_silent)
+            covered = stalled_after | silent_after
+            if stalled_after and all(r in covered for r in expected):
+                steps = {
+                    self._ranks[r].get("step") for r in stalled_after
+                }
+                hang = (VERDICT_DEADLOCK if len(steps) <= 1
+                        else VERDICT_STRAGGLER)
+        return new_stalled, new_silent, hang
+
+    def poll(self):
+        """Run one detection pass. Returns ``{"new_stalled": [...],
+        "new_silent": [...], "hang": verdict-or-None}`` — empty/None
+        between check intervals and after the hang has been declared
+        (one hang per gang attempt)."""
+        from sparkdl_tpu import observe
+
+        now = self._clock()
+        report = {"new_stalled": [], "new_silent": [], "hang": None}
+        if now < self._next_check:
+            return report
+        self._next_check = now + self._check_every
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            new_stalled, new_silent, hang = self._classify_locked(now)
+            self._stalled.update(new_stalled)
+            self._silent.update(new_silent)
+            if hang is not None:
+                self._hang_verdict = hang
+            stalled_info = {
+                r: dict(self._ranks[r]) for r in new_stalled
+            }
+        for rank in sorted(new_stalled):
+            info = stalled_info[rank]
+            observe.instant(
+                "health.stall", cat="health", rank=rank,
+                verdict=VERDICT_STALL, step=info.get("step"),
+                progress=info.get("progress"),
+                collective=info.get("collective"),
+                stalled_for_s=round(now - info["progress_t"], 1),
+            )
+            observe.inc("gang_stalls_total", verdict=VERDICT_STALL)
+        for rank in sorted(new_silent):
+            observe.instant(
+                "health.silent", cat="health", rank=rank,
+                verdict=VERDICT_SILENT,
+            )
+            observe.inc("gang_stalls_total", verdict=VERDICT_SILENT)
+        if hang is not None:
+            observe.instant(
+                "health.hang", cat="health", verdict=hang,
+                stalled=sorted(self._stalled),
+                silent=sorted(self._silent),
+            )
+            observe.inc("gang_stalls_total", verdict=hang)
+        report["new_stalled"] = sorted(new_stalled)
+        report["new_silent"] = sorted(new_silent)
+        report["hang"] = hang
+        return report
+
+    def note_stack_dump(self, rank):
+        """A requested stack dump arrived (called by the control
+        plane): mark the moment on the timeline so the postmortem can
+        order detection → dump → relaunch."""
+        from sparkdl_tpu import observe
+
+        observe.instant("health.stack_dump", cat="health", rank=int(rank))
+
+    @property
+    def stalled_ranks(self):
+        with self._lock:
+            return sorted(self._stalled)
+
+    @property
+    def hang_verdict(self):
+        with self._lock:
+            return self._hang_verdict
+
+    def describe(self):
+        """One human line per rank — the evidence block of a
+        ``kind="hang"`` GangFailure message (and of the doctor's
+        report, which re-reads it from ``health.json``)."""
+        with self._lock:
+            lines = []
+            for rank in sorted(self._ranks):
+                info = self._ranks[rank]
+                state = ("stalled" if rank in self._stalled
+                         else "silent" if rank in self._silent
+                         else "progressing")
+                coll = info.get("collective")
+                lines.append(
+                    f"rank {rank}: {state} @ step {info.get('step')}"
+                    + (f", last entered {coll}" if coll else "")
+                    + f", progress counter {info.get('progress')}"
+                )
+            return "\n".join(lines)
+
+    def summary(self):
+        """JSON-able detector state for ``health.json`` in the merged
+        run dir (what ``observe.doctor`` diagnoses from)."""
+        with self._lock:
+            return {
+                "num_workers": self.num_workers,
+                "stall_s": self.stall_s,
+                "hang_verdict": self._hang_verdict,
+                "stalled": sorted(self._stalled),
+                "silent": sorted(self._silent),
+                "ranks": {
+                    str(r): {
+                        "step": info.get("step"),
+                        "progress": info.get("progress"),
+                        "collective": info.get("collective"),
+                        "hbm": info.get("hbm") or {},
+                    }
+                    for r, info in self._ranks.items()
+                },
+            }
+
+
+def _reset_for_tests():
+    with _state_lock:
+        _state.update({"step": None, "progress": 0, "collective": None})
+
+
+def dump_all_threads():
+    """faulthandler all-thread stack dump as text — what a worker
+    answers a driver dump request with. faulthandler needs a real
+    fd, so spool through an unlinked temp file."""
+    import faulthandler
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.seek(0)
+        return f.read()
+
+
+__all__ = [
+    "HeartbeatSender", "HangDetector", "heartbeat_payload",
+    "note_step", "note_collective", "progress_snapshot",
+    "export_device_memory", "dump_all_threads",
+    "heartbeat_interval", "stall_seconds",
+    "VERDICT_STALL", "VERDICT_SILENT", "VERDICT_STRAGGLER",
+    "VERDICT_DEADLOCK",
+]

@@ -44,6 +44,17 @@ class Timeline:
         self._perf = perf
         self._lock = threading.Lock()
         self._events = []
+        # Optional per-event mirror (the flight recorder): called with
+        # each completed event OUTSIDE the buffer lock, must not raise.
+        self.observer = None
+
+    def _mirror(self, ev):
+        obs = self.observer
+        if obs is not None:
+            try:
+                obs(ev)
+            except Exception:
+                pass  # the mirror must never break recording
 
     def instant(self, name, cat="", **args):
         """Record a point event (``ph: "i"``, process-scoped)."""
@@ -54,6 +65,7 @@ class Timeline:
         }
         with self._lock:
             self._events.append(ev)
+        self._mirror(ev)
         return ev
 
     @contextlib.contextmanager
@@ -72,6 +84,7 @@ class Timeline:
             }
             with self._lock:
                 self._events.append(ev)
+            self._mirror(ev)
 
     def drain(self):
         """Pop and return all buffered events (the flush unit)."""

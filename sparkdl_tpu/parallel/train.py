@@ -118,6 +118,14 @@ def instrument_step(step_fn, name="train_step"):
     def stepped(*args, **kwargs):
         if not observe.enabled():
             return step_fn(*args, **kwargs)
+        from sparkdl_tpu.observe import health
+
+        # Step ENTRY is the gang-health progress marker: a rank that
+        # stops entering steps stops moving this counter, which is
+        # what the driver's HangDetector declares a stall on. Entry
+        # (not exit) so a long first-step compile pins the counter
+        # for at most one compile.
+        health.note_step(state["calls"])
         phase = "compile" if state["calls"] == 0 else "execute"
         t0 = time.perf_counter()
         with observe.span(name, cat="train", step=state["calls"],

@@ -43,7 +43,18 @@ per-gang env — or a test's monkeypatch before launch — scopes them):
   (default: all ranks).
 - ``SPARKDL_TPU_CHAOS_CP_DELAY_S``: delay every control frame.
 - ``SPARKDL_TPU_CHAOS_CP_DROP``: comma list of frame names to drop:
-  READY, LOG, USERLOG, RESULT, EXC, BYE.
+  READY, LOG, USERLOG, RESULT, EXC, BYE, HEARTBEAT, STACK_DUMP.
+- ``SPARKDL_TPU_CHAOS_STALL_STEP``: step at which ``chaos_step``
+  hangs this rank INSIDE the step, forever — the process stays
+  alive and its heartbeat thread keeps beating, which is exactly
+  the silent-hang signature the driver's HangDetector exists to
+  catch (docs/observability.rst). Honors the ONCE file so a
+  supervised relaunch runs clean.
+- ``SPARKDL_TPU_CHAOS_STALL_STEP_RANK``: rank that stalls in-step
+  (default 0).
+- ``SPARKDL_TPU_CHAOS_MUTE_HEARTBEAT``: rank whose heartbeat
+  beacons stop while the process stays alive — exercises the
+  detector's *silent* verdict (beats lost without a process death).
 """
 
 import os
@@ -61,6 +72,9 @@ STALL_S_ENV = _PREFIX + "RENDEZVOUS_STALL_S"
 STALL_RANK_ENV = _PREFIX + "RENDEZVOUS_STALL_RANK"
 CP_DELAY_ENV = _PREFIX + "CP_DELAY_S"
 CP_DROP_ENV = _PREFIX + "CP_DROP"
+STALL_STEP_ENV = _PREFIX + "STALL_STEP"
+STALL_STEP_RANK_ENV = _PREFIX + "STALL_STEP_RANK"
+MUTE_HEARTBEAT_ENV = _PREFIX + "MUTE_HEARTBEAT"
 
 # Lazily-latched per process: gangs ship chaos env at spawn, so one
 # check at first hook call suffices and the common (chaos-off) path
@@ -128,11 +142,40 @@ def _kill_self(phase="step", step=None):
     time.sleep(5)
 
 
+def _stall_in_step(step):
+    """Hang this rank inside the step, forever. The process — and
+    crucially its heartbeat thread — stays alive: from the driver
+    this is a rank whose beats continue while its progress counter
+    freezes, the signature the HangDetector turns into stall → hang
+    verdicts, a stack dump naming THIS frame, and a supervised
+    relaunch under the HANG cause."""
+    from sparkdl_tpu import observe
+
+    observe.instant("chaos.stall_in_step", cat="chaos", rank=_rank(),
+                    step=int(step))
+    observe.flush()
+    try:
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    while True:         # until the launcher reaps the hung gang
+        time.sleep(1)
+
+
 def chaos_step(step):
-    """Training-main hook: die here if this (rank, step) is the
-    configured kill point. No-op without chaos env."""
+    """Training-main hook: die (or hang) here if this (rank, step) is
+    the configured injection point. No-op without chaos env."""
     if not _chaos_active():
         return
+    stall_step = os.environ.get(STALL_STEP_ENV)
+    if (stall_step is not None
+            and int(stall_step) == int(step)
+            and int(os.environ.get(STALL_STEP_RANK_ENV, "0")) == _rank()
+            and _claim_once()):
+        _stall_in_step(step)
     kill_rank = os.environ.get(KILL_RANK_ENV)
     if kill_rank is None or int(kill_rank) != _rank():
         return
@@ -142,6 +185,16 @@ def chaos_step(step):
         return
     if _claim_once():
         _kill_self(phase="step", step=int(step))
+
+
+def heartbeat_muted(rank):
+    """Heartbeat-sender hook: True when this rank's beacons are
+    chaos-muted (process alive, beats gone — the detector's *silent*
+    verdict). No-op without chaos env."""
+    if not _chaos_active():
+        return False
+    muted = os.environ.get(MUTE_HEARTBEAT_ENV)
+    return muted is not None and int(muted) == int(rank)
 
 
 def on_worker_boot(rank):

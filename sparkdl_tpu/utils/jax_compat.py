@@ -180,6 +180,54 @@ def deserialize_compiled(payload, in_tree, out_tree):
     return deserialize_and_load(payload, in_tree, out_tree)
 
 
+def device_memory_stats(device=None):
+    """Best-effort accelerator memory gauges for the given (default:
+    first local) device, or ``None`` when nothing can be read.
+
+    Returns a plain dict with whichever of ``bytes_in_use`` /
+    ``peak_bytes_in_use`` / ``bytes_limit`` the PJRT client reports
+    (TPU and GPU clients do; CPU returns None/raises on both jax
+    lines). Deliberately refuses to IMPORT jax: this is called from
+    the heartbeat thread of instrumented workers, and a telemetry
+    beat must never be the thing that initializes a backend — if the
+    process hasn't touched jax yet, there is no device memory to
+    report."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if isinstance(stats.get(key), (int, float)):
+            out[key] = int(stats[key])
+    return out or None
+
+
+def live_buffer_bytes():
+    """Sum of live jax array bytes in this process — the fallback
+    memory gauge where ``memory_stats`` is unimplemented (CPU rigs).
+    Same no-import rule as :func:`device_memory_stats`."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return sum(
+            int(getattr(a, "nbytes", 0) or 0) for a in jax.live_arrays()
+        )
+    except Exception:
+        return None
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` → pre-rename ``TPUCompilerParams``
     (same constructor kwargs; ``dimension_semantics`` et al. carried

@@ -360,3 +360,70 @@ def test_metrics_endpoint_works_without_telemetry_env(monkeypatch):
         assert observe.metrics().snapshot()["counters"] == []
     finally:
         observe._reset_for_tests()
+
+
+def _get_healthz(fe):
+    """(status_code, parsed JSON body) — urllib raises on 503, but the
+    body is still the JSON probes log."""
+    try:
+        with urllib.request.urlopen(
+                f"http://{fe.address[0]}:{fe.address[1]}/healthz",
+                timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_ok_on_live_engine():
+    """GET /healthz (ISSUE 5 satellite): 200 with the machine-readable
+    liveness triple while the engine loop is up."""
+    fe = ServingFrontend(_FakeEngine()).start()
+    try:
+        code, body = _get_healthz(fe)
+        assert code == 200
+        assert body == {"status": "ok", "queue_depth": 0,
+                        "engine_alive": True}
+        # ...and a served request doesn't change liveness
+        with _post_raw(fe, {"tokens": [1], "max_new_tokens": 1}) as r:
+            r.read()
+        assert _get_healthz(fe)[0] == 200
+    finally:
+        fe.close()
+
+
+def test_healthz_503_when_engine_loop_dead():
+    """A dead engine loop (non-Exception escape — PR 1's lifecycle
+    class) must flip /healthz to 503 so a load balancer drains the
+    box, with the body saying WHY."""
+    import time
+
+    fe = ServingFrontend(_FakeEngine(fault=KeyboardInterrupt())).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_raw(fe, {"tokens": [1], "max_new_tokens": 2})
+        assert e.value.code == 503
+        # the loop's finally may still be running: poll briefly
+        deadline = time.monotonic() + 10
+        code, body = _get_healthz(fe)
+        while code != 503 and time.monotonic() < deadline:
+            time.sleep(0.05)
+            code, body = _get_healthz(fe)
+        assert code == 503
+        assert body["status"] == "unavailable"
+        assert body["engine_alive"] is False
+        assert isinstance(body["queue_depth"], int)
+    finally:
+        fe.close()
+
+
+def test_healthz_does_not_pollute_request_metrics():
+    """Probes hit /healthz every few seconds; they must not show up in
+    the request-class counters the SLOs are computed from."""
+    fe = ServingFrontend(_FakeEngine()).start()
+    try:
+        for _ in range(3):
+            assert _get_healthz(fe)[0] == 200
+        _, body = _get(fe, "/metrics")
+        assert "server_requests_total" not in body
+    finally:
+        fe.close()

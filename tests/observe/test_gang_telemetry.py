@@ -89,6 +89,8 @@ def test_write_is_atomic_no_tmp_left_behind(tmp_path, monkeypatch):
 
 
 def _instrumented_main(n_steps):
+    import threading
+
     import numpy as np
 
     import sparkdl_tpu.hvd as hvd
@@ -106,7 +108,10 @@ def _instrumented_main(n_steps):
         stepped(np.full((8,), float(hvd.rank() + 1), np.float32))
     observe.inc("main_markers_total")
     return {"rank": hvd.rank(), "size": hvd.size(),
-            "telemetry_on": observe.enabled()}
+            "telemetry_on": observe.enabled(),
+            # the zero-overhead latch proof reads these back: the
+            # heartbeat thread must exist exactly when telemetry does
+            "threads": sorted(t.name for t in threading.enumerate())}
 
 
 @pytest.mark.gang
@@ -121,6 +126,7 @@ def test_control_plane_round_trip_in_real_gang(monkeypatch, tmp_path):
 
     result = HorovodRunner(np=-2).run(_instrumented_main, n_steps=3)
     assert result["telemetry_on"] is True
+    assert "sparkdl-tpu-heartbeat" in result["threads"]
 
     run_dirs = glob.glob(str(tmp_path / "run-*"))
     assert len(run_dirs) == 1, run_dirs
@@ -159,6 +165,10 @@ def test_gang_without_telemetry_writes_nothing(monkeypatch, tmp_path):
     result = HorovodRunner(np=-2).run(_instrumented_main, n_steps=1)
     assert result["telemetry_on"] is False
     assert glob.glob(str(tmp_path / "run-*")) == []
+    # the latch covers gang health too: no heartbeat thread, ever
+    # (ISSUE 5: "with SPARKDL_TPU_TELEMETRY_DIR unset, heartbeats
+    # stay fully disabled")
+    assert "sparkdl-tpu-heartbeat" not in result["threads"]
 
 
 def test_second_launch_does_not_inherit_driver_counters(
@@ -179,6 +189,61 @@ def test_second_launch_does_not_inherit_driver_counters(
     prom2 = open(tmp_path / "b" / "metrics.prom").read()
     assert "gang_restarts_total" not in prom2      # run 1's, not run 2's
     assert 'gang_attempts_total{rank="driver"} 1' in prom2
+
+
+def test_rank_dead_mid_flush_keeps_tail_and_never_double_counts(
+        tmp_path, monkeypatch):
+    """ISSUE 5 satellite: a rank SIGKILLed between flushes. Its last
+    cumulative snapshot must count ONCE (two flushes from one pid are
+    the same incarnation, not two), and its flight-recorder ring —
+    the only record of the events after the final flush that never
+    happened — must be recovered into the merged run dir."""
+    from sparkdl_tpu.observe.flightrec import FlightRecorder, ring_path
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    gt.note_job_dir(str(job_dir))
+
+    # rank 1: two flushes from one incarnation (pid 100), then death —
+    # the second snapshot is cumulative and SUPERSEDES the first
+    gt.ingest(1, _payload(100, counters=[("steps_total", 2)],
+                          events=[_instant("flushed-1", 10)]))
+    gt.ingest(1, _payload(100, counters=[("steps_total", 5)]))
+    # its ring has events from AFTER that flush, written up to the
+    # SIGKILL (no close, like the real thing)
+    rec = FlightRecorder(ring_path(str(job_dir), 1), nslots=16)
+    rec.record({"name": "flushed-1", "ph": "i", "ts": 10})
+    rec.record({"name": "post-flush-step", "ph": "i", "ts": 20})
+    rec.flush()  # what the kernel does for a SIGKILLed mmap writer
+    # a surviving rank 0, one incarnation
+    gt.ingest(0, _payload(300, counters=[("steps_total", 7)]))
+
+    paths = gt.write(str(tmp_path / "out"))
+    prom = open(paths["metrics.prom"]).read()
+    assert 'steps_total{rank="1"} 5' in prom       # not 2+5
+    assert 'steps_total{rank="0"} 7' in prom
+    assert "flightrec-rank-1.json" in paths
+    doc = json.loads(open(paths["flightrec-rank-1.json"]).read())
+    assert doc["rank"] == 1
+    assert [e["name"] for e in doc["events"]] == [
+        "flushed-1", "post-flush-step"]
+
+
+def test_stack_dumps_and_health_summary_land_in_run_dir(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    gt.add_stack_dump(1, 'File "x.py", line 3 in wedged', reason="stall")
+    gt.add_health_summary({"hang_verdict": "straggler", "stalled": [1]})
+    paths = gt.write(str(tmp_path / "out"))
+    dump = open(paths["stack-rank-1.txt"]).read()
+    assert "reason: stall" in dump and "wedged" in dump
+    health = json.loads(open(paths["health.json"]).read())
+    assert health["attempts"][0]["hang_verdict"] == "straggler"
 
 
 def test_malformed_histogram_and_values_rejected_at_ingest():

@@ -1,0 +1,191 @@
+"""The acceptance proof (ISSUE 5: observability / gang health): a
+rank chaos-stalled INSIDE a step — process alive, heartbeats flowing —
+must make the gang diagnose itself: the driver emits ``stall`` then
+``hang`` verdict instants, captures the stalled rank's faulthandler
+stack dump naming the wedged frame, the supervisor relaunches under
+the HANG cause and resumes from checkpoint, the SIGKILLed rank's
+flight-recorder tail is recovered into the merged run dir, and
+``observe.doctor`` reproduces the verdict from the artifacts alone
+with a nonzero exit.
+
+Marked like the PR-1/PR-3 gang chaos proofs: ``gang`` + ``slow`` +
+``chaos`` so the time-boxed tier-1 gate stays honest and CI runs them
+in the dedicated chaos step.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sparkdl import HorovodRunner
+from sparkdl_tpu import observe
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _ckpt_train_main(ckpt_dir, total_steps):
+    """The PR-3 checkpointed chaos main, unchanged shape: allreduce
+    per step, durable saves, chaos hook — the stall injection rides
+    chaos_step exactly like the kill injection did."""
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.parallel.train import instrument_step
+    from sparkdl_tpu.utils.chaos import chaos_step
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    hvd.init()
+    ctx = restart_context()
+    ckpt = TrainCheckpointer(ckpt_dir)
+    w = np.zeros((4,), np.float32)
+    start = 0
+    if ctx.resume_step is not None:
+        restored = ckpt.restore(
+            ctx.resume_step, target={"w": np.zeros((4,), np.float32)})
+        w = np.asarray(restored["w"])
+        start = ctx.resume_step + 1
+
+    def one_step(step, w):
+        g = hvd.allreduce(
+            np.full((4,), float((hvd.rank() + 1) * (step + 1)),
+                    np.float32),
+            op=hvd.Sum)
+        return (w - 0.01 * np.asarray(g)).astype(np.float32)
+
+    stepped = instrument_step(one_step)
+    try:
+        for step in range(start, total_steps):
+            w = stepped(step, w)
+            ckpt.save(step, {"w": w})
+            ckpt.wait_until_finished()
+            hvd.barrier()
+            chaos_step(step)
+    finally:
+        ckpt.close()
+    return {"w": w.tolist(), "attempt": ctx.attempt}
+
+
+@pytest.mark.gang
+@pytest.mark.slow
+def test_hung_gang_diagnoses_itself_and_resumes(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV,
+                       str(tmp_path / "telemetry"))
+    observe._reset_for_tests()
+    monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_BASE", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_MAX", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_RESUME_DIR",
+                       str(tmp_path / "ck"))
+    monkeypatch.setenv("SPARKDL_TPU_ABORT_GRACE", "5")
+    # Fast health clock: beats 5x/sec, stall after 8s, dumps bounded.
+    # The stall window must exceed the slowest LEGITIMATE single op —
+    # here the first allreduce pays gloo connect + XLA compile (~3s on
+    # a loaded CI box) with the progress counter pinned at its entry —
+    # or clean steps read as stalls (the same sizing rule
+    # docs/observability.rst gives for production STALL_S vs compile).
+    monkeypatch.setenv("SPARKDL_TPU_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_STALL_S", "8")
+    monkeypatch.setenv("SPARKDL_TPU_DUMP_GRACE", "5")
+    # The injection: rank 1 hangs inside step 2, beats continuing
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_STALL_STEP", "2")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_STALL_STEP_RANK", "1")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_ONCE_FILE",
+                       str(tmp_path / "one-stall"))
+
+    result = HorovodRunner(np=-2).run(
+        _ckpt_train_main, ckpt_dir=str(tmp_path / "ck"), total_steps=4)
+    assert result["attempt"] == 1          # relaunched exactly once
+
+    run_dirs = glob.glob(str(tmp_path / "telemetry" / "run-*"))
+    assert len(run_dirs) == 1, run_dirs
+    run = run_dirs[0]
+
+    # -- Prometheus view: alertable stall/hang counters --------------
+    prom = open(os.path.join(run, "metrics.prom")).read()
+    stall_lines = [
+        l for l in prom.splitlines()
+        if l.startswith("gang_stalls_total") and 'rank="driver"' in l
+    ]
+    verdicts = {l.split('verdict="')[1].split('"')[0] for l in stall_lines}
+    assert "stall" in verdicts
+    assert verdicts & {"straggler", "deadlock"}
+    (line,) = [l for l in prom.splitlines()
+               if l.startswith('gang_restarts_total{rank="driver"}')]
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+
+    # -- timeline: stall -> hang -> classified HANG -> resume --------
+    trace = json.loads(open(os.path.join(run, "timeline.json")).read())
+    events = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+
+    def first_ts(name, **match):
+        cands = [
+            e["ts"] for e in events
+            if e["name"] == name
+            and all(e["args"].get(k) == v for k, v in match.items())
+        ]
+        assert cands, (
+            f"event {name} {match} missing; have "
+            f"{sorted({e['name'] for e in events})}")
+        return min(cands)
+
+    inject_ts = first_ts("chaos.stall_in_step", rank=1, step=2)
+    stall_ts = first_ts("health.stall", rank=1)
+    hang_ts = first_ts("health.hang")
+    resume_ts = first_ts("gang.resume", attempt=1)
+    assert inject_ts < stall_ts <= hang_ts < resume_ts
+    (hang_ev,) = [e for e in events if e["name"] == "health.hang"]
+    assert hang_ev["args"]["verdict"] in ("straggler", "deadlock")
+    assert 1 in hang_ev["args"]["stalled"]
+    # the supervisor classified it transient under the HANG cause
+    (fail_ev,) = [e for e in events if e["name"] == "gang.failure"]
+    assert fail_ev["args"]["verdict"] == "transient"
+    assert "HANG" in fail_ev["args"]["cause"]
+    # the dump round trip is on the timeline too
+    assert any(e["name"] == "health.stack_dump" for e in events)
+    # resumed from the committed checkpoint (one resume marker per
+    # relaunched worker process)
+    resume_evs = [e for e in events if e["name"] == "gang.resume"]
+    assert resume_evs
+    assert all(e["args"]["resume_step"] == 2 for e in resume_evs)
+
+    # -- stack dump: names the wedged frame --------------------------
+    dump = open(os.path.join(run, "stack-rank-1.txt")).read()
+    assert "_stall_in_step" in dump
+
+    # -- flight recorder: the SIGKILLed rank's tail survived ---------
+    # (the launcher reaps a hung gang with SIGKILL — rank 1's final
+    # telemetry flush never ran, but its ring did)
+    rec = json.loads(
+        open(os.path.join(run, "flightrec-rank-1.json")).read())
+    names = {e.get("name") for e in rec["events"]}
+    assert "chaos.stall_in_step" in names
+
+    # -- health.json + doctor: verdict reproducible offline ----------
+    health_doc = json.loads(
+        open(os.path.join(run, "health.json")).read())
+    assert any(a.get("hang_verdict") for a in health_doc["attempts"])
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "HANG" in r.stdout
+    assert "rank 1" in r.stdout

@@ -1,0 +1,522 @@
+"""Gang health units: HangDetector verdicts, heartbeat beacons, the
+flight recorder's SIGKILL survival, the control-plane dump-request
+round trip, and the observe.doctor postmortem — everything below gang
+scale (the full chaos acceptance lives in test_hang_chaos.py)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe import health
+from sparkdl_tpu.observe.flightrec import (
+    FlightRecorder,
+    recover_job_dir,
+    ring_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _clocked_detector(num_workers=2, stall_s=10.0):
+    t = {"now": 0.0}
+    det = health.HangDetector(
+        num_workers, stall_s=stall_s, clock=lambda: t["now"],
+        check_every=0,
+    )
+    return det, t
+
+
+def _beat(det, rank, progress, step=None, collective=None):
+    det.observe_beat(rank, {"progress": progress, "step": step,
+                            "collective": collective})
+
+
+class TestHangDetector:
+    def test_progressing_gang_never_stalls(self):
+        det, t = _clocked_detector()
+        for now in range(0, 40, 5):
+            t["now"] = float(now)
+            _beat(det, 0, progress=now + 1, step=now)
+            _beat(det, 1, progress=now + 1, step=now)
+            r = det.poll()
+            assert r["new_stalled"] == [] and r["hang"] is None
+
+    def test_straggler_stall_then_hang(self):
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=1, step=1, collective="reduce")
+        _beat(det, 1, progress=1, step=1)
+        det.poll()
+        # rank 0 progresses to step 2 then blocks in the collective;
+        # rank 1 froze at step 1
+        t["now"] = 5.0
+        _beat(det, 0, progress=3, step=2, collective="reduce")
+        _beat(det, 1, progress=1, step=1)
+        t["now"] = 16.0     # > stall_s past BOTH ranks' last progress
+        _beat(det, 0, progress=3, step=2, collective="reduce")
+        _beat(det, 1, progress=1, step=1)
+        r = det.poll()
+        assert set(r["new_stalled"]) == {0, 1}
+        # steps differ across the stalled set: a laggard dragged the
+        # gang down — straggler, not deadlock
+        assert r["hang"] == health.VERDICT_STRAGGLER
+        # one hang per attempt: later polls stay quiet
+        t["now"] = 30.0
+        assert det.poll()["hang"] is None
+        assert det.hang_verdict == health.VERDICT_STRAGGLER
+        assert det.stalled_ranks == [0, 1]
+        assert "last entered reduce" in det.describe()
+
+    def test_symmetric_wedge_is_deadlock(self):
+        det, t = _clocked_detector()
+        for r in (0, 1):
+            _beat(det, r, progress=2, step=7, collective="allgather")
+        det.poll()
+        t["now"] = 12.0
+        for r in (0, 1):
+            _beat(det, r, progress=2, step=7, collective="allgather")
+        r = det.poll()
+        assert r["hang"] == health.VERDICT_DEADLOCK
+
+    def test_silent_rank_gets_silent_verdict(self):
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=1, step=0)
+        _beat(det, 1, progress=1, step=0)
+        det.poll()
+        # rank 1's beats stop (process alive — the MUTE_HEARTBEAT
+        # chaos lever); rank 0 keeps beating AND progressing
+        t["now"] = 12.0
+        _beat(det, 0, progress=9, step=4)
+        r = det.poll()
+        assert r["new_silent"] == [1]
+        assert r["hang"] is None        # rank 0 still progressing
+        # resumed beats clear the silent state
+        _beat(det, 1, progress=2, step=1)
+        assert 1 not in det.summary()["silent"]
+
+    def test_never_beat_rank_goes_silent_and_cannot_veto_hang(self):
+        # A rank whose beacon NEVER arrives (muted from boot, dead
+        # heartbeat thread, dropped frames) must get the silent
+        # verdict once the gang has run a full window — and must not
+        # block the hang verdict when its peer wedges waiting for it.
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=1, step=0)
+        det.poll()                      # t0 = 0; rank 1 never beats
+        t["now"] = 11.0
+        _beat(det, 0, progress=5, step=2, collective="reduce")
+        r = det.poll()
+        assert r["new_silent"] == [1]
+        assert r["hang"] is None        # rank 0 still progressing
+        t["now"] = 23.0                 # now rank 0 wedged too
+        _beat(det, 0, progress=5, step=2, collective="reduce")
+        r = det.poll()
+        assert r["new_stalled"] == [0]
+        assert r["hang"] is not None    # silent rank 1 didn't veto it
+
+    def test_recovered_rank_sheds_its_stall_verdict(self):
+        # One transient over-window stall must not permanently mark a
+        # rank: a later hang classification has to see it as
+        # progressing, not condemn a gang that is half-alive.
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=1, step=0)
+        _beat(det, 1, progress=1, step=0)
+        det.poll()
+        t["now"] = 11.0
+        _beat(det, 0, progress=1, step=0)
+        _beat(det, 1, progress=1, step=0)
+        r = det.poll()
+        assert set(r["new_stalled"]) == {0, 1}
+        assert r["hang"] is not None
+        # fresh detector (one hang per attempt): stall, recover, then
+        # ONLY the other rank stalls — no hang
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=1, step=0)
+        _beat(det, 1, progress=1, step=0)
+        det.poll()
+        t["now"] = 11.0
+        _beat(det, 0, progress=1, step=0)
+        _beat(det, 1, progress=9, step=3)
+        r = det.poll()
+        assert r["new_stalled"] == [0]
+        t["now"] = 12.0
+        _beat(det, 0, progress=7, step=1)   # rank 0 recovers
+        assert det.stalled_ranks == []
+        t["now"] = 23.0
+        _beat(det, 0, progress=20, step=5)  # still moving
+        _beat(det, 1, progress=9, step=3)   # rank 1 now wedged
+        r = det.poll()
+        assert r["new_stalled"] == [1]
+        assert r["hang"] is None            # rank 0 is alive — no hang
+
+    def test_uninstrumented_main_never_declared_hung(self):
+        # A rank that never reports progress > 0 (no instrument_step,
+        # no collectives) must not be stall-eligible — killing an
+        # uninstrumented-but-working gang would be a detector bug
+        # worse than any hang.
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=0)
+        _beat(det, 1, progress=0)
+        det.poll()
+        t["now"] = 100.0
+        _beat(det, 0, progress=0)
+        _beat(det, 1, progress=0)
+        r = det.poll()
+        assert r["new_stalled"] == [] and r["hang"] is None
+
+    def test_verdict_instants_and_counters_emitted(self, monkeypatch,
+                                                   tmp_path):
+        monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+        observe._reset_for_tests()
+        det, t = _clocked_detector()
+        _beat(det, 0, progress=1, step=1)
+        _beat(det, 1, progress=1, step=2)
+        det.poll()
+        t["now"] = 11.0
+        _beat(det, 0, progress=1, step=1)
+        _beat(det, 1, progress=1, step=2)
+        det.poll()
+        events = observe.timeline().drain()
+        names = [e["name"] for e in events]
+        assert names.count("health.stall") == 2
+        assert names.count("health.hang") == 1
+        stall_ts = [e["ts"] for e in events if e["name"] == "health.stall"]
+        hang_ts = [e["ts"] for e in events if e["name"] == "health.hang"]
+        assert max(stall_ts) <= min(hang_ts)    # stall before hang
+        snap = observe.metrics().snapshot()
+        counts = {
+            tuple(sorted(c["labels"].items())): c["value"]
+            for c in snap["counters"]
+            if c["name"] == "gang_stalls_total"
+        }
+        assert counts[(("verdict", "stall"),)] == 2
+        assert counts[(("verdict", "straggler"),)] == 1
+
+
+class TestHeartbeat:
+    def test_payload_carries_progress_and_sets_gauges(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+        observe._reset_for_tests()
+        health.note_step(41)
+        health.note_collective("reduce")
+        payload = health.heartbeat_payload(rank=3)
+        assert payload["rank"] == 3
+        assert payload["step"] == 41
+        assert payload["collective"] == "reduce"
+        assert payload["progress"] == 2     # step entry + op entry
+        gauges = {g["name"] for g in
+                  observe.metrics().snapshot()["gauges"]}
+        assert "worker_step" in gauges
+
+    def test_sender_ships_beats_and_chaos_mutes(self, monkeypatch,
+                                                tmp_path):
+        from sparkdl_tpu.utils import chaos
+
+        monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+        observe._reset_for_tests()
+
+        class FakeClient:
+            def __init__(self):
+                self.beats = []
+
+            def send_heartbeat(self, payload):
+                self.beats.append(payload)
+
+        client = FakeClient()
+        sender = health.HeartbeatSender(client, rank=1, interval=3600)
+        assert sender.beat() is True
+        assert client.beats[0]["rank"] == 1
+        # chaos mute: beats stop, nothing raises
+        monkeypatch.setenv(chaos.MUTE_HEARTBEAT_ENV, "1")
+        chaos._reset_cache_for_tests()
+        try:
+            assert sender.beat() is False
+            assert len(client.beats) == 1
+        finally:
+            monkeypatch.delenv(chaos.MUTE_HEARTBEAT_ENV)
+            chaos._reset_cache_for_tests()
+
+    def test_zero_overhead_latch_extends_to_health(self, monkeypatch):
+        # The PR-3 contract, extended: with SPARKDL_TPU_TELEMETRY_DIR
+        # unset, the whole health layer stays inert — the instrumented
+        # step/collective hooks never reach note_step/note_collective
+        # (they sit behind the callers' enabled() latch), so the
+        # progress state never moves and nothing heartbeat-shaped
+        # exists to ship.
+        monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+        observe._reset_for_tests()
+        assert not observe.enabled()
+        from sparkdl_tpu.parallel.train import instrument_step
+
+        stepped = instrument_step(lambda x: x + 1)
+        assert stepped(1) == 2
+        assert health.progress_snapshot() == {
+            "step": None, "progress": 0, "collective": None}
+        # and a disabled-interval sender refuses to spawn a thread
+        sender = health.HeartbeatSender(object(), rank=0, interval=0)
+        assert sender.start() is None
+
+
+class TestFlightRecorder:
+    def test_wraps_and_orders(self, tmp_path):
+        path = ring_path(str(tmp_path), 0)
+        rec = FlightRecorder(path, nslots=8)
+        for i in range(20):
+            rec.record({"name": f"ev{i}", "ph": "i", "ts": i})
+        rec.close()
+        tail = FlightRecorder.read_tail(path)
+        assert [e["name"] for e in tail] == [f"ev{i}" for i in range(12, 20)]
+
+    def test_torn_slot_dropped_not_fatal(self, tmp_path):
+        path = ring_path(str(tmp_path), 0)
+        rec = FlightRecorder(path, nslots=4)
+        for i in range(4):
+            rec.record({"name": f"ev{i}", "ts": i})
+        rec.close()
+        # garble one slot's payload byte (a write torn by SIGKILL)
+        with open(path, "r+b") as f:
+            f.seek(16 + 1 * 1024 + 12 + 3)  # header + slot 1 + slot head
+            f.write(b"\xff")
+        tail = FlightRecorder.read_tail(path)
+        names = [e["name"] for e in tail]
+        assert "ev1" not in names and {"ev0", "ev2", "ev3"} <= set(names)
+
+    def test_oversized_event_truncated_but_recorded(self, tmp_path):
+        path = ring_path(str(tmp_path), 0)
+        rec = FlightRecorder(path, nslots=4)
+        rec.record({"name": "big", "ts": 1, "args": {"blob": "x" * 4096}})
+        rec.close()
+        (ev,) = FlightRecorder.read_tail(path)
+        assert ev["name"] == "big" and ev["truncated"] is True
+
+    def test_not_a_ring_raises(self, tmp_path):
+        p = tmp_path / "nope.ring"
+        p.write_bytes(b"just some file" * 10)
+        with pytest.raises(ValueError, match="not a flight-recorder"):
+            FlightRecorder.read_tail(str(p))
+
+    def test_tail_survives_sigkill(self, tmp_path):
+        """The whole point: a SIGKILLed writer (no close, no flush, no
+        exit handlers) leaves a readable tail via the kernel's
+        MAP_SHARED writeback."""
+        path = ring_path(str(tmp_path), 1)
+        code = (
+            "import os, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from sparkdl_tpu.observe.flightrec import FlightRecorder\n"
+            "rec = FlightRecorder(%r, nslots=16)\n"
+            "for i in range(10):\n"
+            "    rec.record({'name': 'pre-kill-%%d' %% i, 'ts': i})\n"
+            "print('ready', flush=True)\n"
+            "import time\n"
+            "time.sleep(60)\n"
+        ) % (os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), path)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            assert proc.stdout.readline().strip() == "ready"
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        tail = FlightRecorder.read_tail(path)
+        assert [e["name"] for e in tail] == [
+            f"pre-kill-{i}" for i in range(10)]
+        assert recover_job_dir(str(tmp_path)) == {1: tail}
+
+    def test_timeline_mirror_via_facade(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+        observe._reset_for_tests()
+        path = ring_path(str(tmp_path), 0)
+        rec = FlightRecorder(path, nslots=8)
+        observe.set_flight_recorder(rec)
+        observe.instant("mirrored", cat="t", step=1)
+        with observe.span("spanned", cat="t"):
+            pass
+        observe.set_flight_recorder(None)
+        rec.close()
+        names = [e["name"] for e in FlightRecorder.read_tail(path)]
+        assert names == ["mirrored", "spanned"]
+
+
+class TestDumpRoundTrip:
+    def test_driver_requests_dump_worker_answers_with_stacks(
+            self, monkeypatch):
+        """The driver→worker diagnosis channel end to end, no gang:
+        the client's watchdog reader answers a DUMP_REQ with a
+        faulthandler all-thread dump naming live frames."""
+        from sparkdl_tpu.horovod import control_plane as cp
+
+        beats = []
+
+        class DetStub:
+            def observe_beat(self, rank, payload):
+                beats.append((rank, payload))
+
+            def note_stack_dump(self, rank):
+                pass
+
+        server = cp.ControlPlaneServer(1, health=DetStub())
+        monkeypatch.setenv(cp.CONTROL_SECRET_ENV, server.secret)
+        monkeypatch.setenv("SPARKDL_TPU_NATIVE_LOGS", "0")
+        client = cp.ControlPlaneClient(server.address, rank=0)
+        try:
+            client.start_driver_watchdog()
+            client.send_heartbeat({"progress": 1, "step": 4})
+            deadline = time.monotonic() + 10
+            while not beats and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert beats and beats[0][0] == 0
+            assert beats[0][1]["step"] == 4
+            assert server.request_dump(0, reason="stall") is True
+            deadline = time.monotonic() + 10
+            while not server.stack_dumps(0) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            (dump,) = server.stack_dumps(0)
+            # faulthandler format: every thread's frames, this test
+            # among them
+            assert "Thread" in dump or "Current thread" in dump
+            assert "test_health.py" in dump
+        finally:
+            client.close()
+            server.close()
+
+    def test_request_dump_unknown_rank_is_false_not_fatal(self):
+        from sparkdl_tpu.horovod import control_plane as cp
+
+        server = cp.ControlPlaneServer(1)
+        try:
+            assert server.request_dump(7) is False
+        finally:
+            server.close()
+
+
+# -- doctor ------------------------------------------------------------------
+
+
+def _write_run_dir(tmp_path, *, hang=True):
+    run = tmp_path / "run-1-0"
+    run.mkdir()
+    events = [
+        {"name": "health.stall", "cat": "health", "ph": "i", "ts": 100,
+         "pid": 0, "tid": 1, "s": "p",
+         "args": {"rank": 1, "verdict": "stall", "step": 417,
+                  "collective": "reduce"}},
+        {"name": "gang.failure", "cat": "supervisor", "ph": "i",
+         "ts": 300, "pid": 0, "tid": 1, "s": "p",
+         "args": {"attempt": 1, "verdict": "transient",
+                  "cause": "HANG (straggler) — gang made no progress"}},
+        {"name": "gang.resume", "cat": "supervisor", "ph": "i",
+         "ts": 400, "pid": 2, "tid": 1, "s": "p",
+         "args": {"attempt": 1, "resume_step": 416}},
+    ]
+    if hang:
+        events.insert(1, {
+            "name": "health.hang", "cat": "health", "ph": "i",
+            "ts": 200, "pid": 0, "tid": 1, "s": "p",
+            "args": {"verdict": "straggler", "stalled": [1],
+                     "silent": []}})
+    (run / "timeline.json").write_text(
+        json.dumps({"traceEvents": events}))
+    (run / "health.json").write_text(json.dumps({"attempts": [{
+        "num_workers": 2, "stall_s": 2.0,
+        "hang_verdict": "straggler" if hang else None,
+        "stalled": [1] if hang else [], "silent": [],
+        "ranks": {
+            "0": {"step": 418, "progress": 9, "collective": "reduce",
+                  "hbm": {"peak": 15247630336}},
+            "1": {"step": 417, "progress": 5, "collective": "reduce",
+                  "hbm": {}},
+        },
+    }]}))
+    (run / "stack-rank-1.txt").write_text(
+        "==== stack dump (reason: stall) ====\n"
+        'File "chaos.py", line 1 in _stall_in_step\n')
+    (run / "flightrec-rank-1.json").write_text(json.dumps(
+        {"rank": 1, "events": [{"name": "chaos.stall_in_step"}]}))
+    return str(run)
+
+
+class TestDoctor:
+    def test_hang_run_diagnosed_nonzero_exit(self, tmp_path, capsys):
+        from sparkdl_tpu.observe import doctor
+
+        run = _write_run_dir(tmp_path, hang=True)
+        rc = doctor.main([run])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "HANG (straggler)" in out
+        assert "rank 1: stalled @ step 417" in out
+        assert "last entered reduce" in out
+        assert "rank 0: progressed to step 418" in out
+        assert "14.2 GiB" in out            # HBM high-water rendered
+        assert "stack-rank-1.txt" in out
+
+    def test_clean_run_exits_zero(self, tmp_path, capsys):
+        from sparkdl_tpu.observe import doctor
+
+        run = tmp_path / "run-2-0"
+        run.mkdir()
+        (run / "timeline.json").write_text(
+            json.dumps({"traceEvents": []}))
+        (run / "metrics.json").write_text(json.dumps(
+            {"generated_at": 0, "series": []}))
+        assert doctor.main([str(run)]) == 0
+        assert "no hang found" in capsys.readouterr().out
+
+    def test_json_format_is_parseable_and_complete(self, tmp_path,
+                                                   capsys):
+        from sparkdl_tpu.observe import doctor
+
+        run = _write_run_dir(tmp_path, hang=True)
+        assert doctor.main([run, "--format", "json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["hang"] is True
+        assert doc["verdict"] == "straggler"
+        assert doc["stalled_ranks"] == [1]
+        assert doc["stack_dumps"] == {"1": "stack-rank-1.txt"}
+        assert doc["flight_recorder_events"] == {"1": 1}
+
+    def test_verdict_reproduced_from_timeline_alone(self, tmp_path):
+        # health.json lost (e.g. a partial copy): the health.hang
+        # instant on the timeline still carries the verdict.
+        from sparkdl_tpu.observe import doctor
+
+        run = _write_run_dir(tmp_path, hang=True)
+        os.unlink(os.path.join(run, "health.json"))
+        diag = doctor.diagnose(run)
+        assert diag["hang"] is True and diag["verdict"] == "straggler"
+
+    def test_empty_dir_is_usage_error(self, tmp_path, capsys):
+        from sparkdl_tpu.observe import doctor
+
+        assert doctor.main([str(tmp_path)]) == 2
+        assert "no telemetry artifacts" in capsys.readouterr().err
+
+    def test_doctor_cli_entrypoint(self, tmp_path):
+        run = _write_run_dir(tmp_path, hang=True)
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert r.returncode == 1, r.stderr
+        assert "HANG" in r.stdout

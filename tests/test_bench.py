@@ -45,6 +45,14 @@ def test_bench_emits_single_json_line_on_cpu():
     # to a ready executable, and whether the AOT cache served it
     assert out["compile_seconds"] >= 0
     assert out["warm_start"] in (True, False)
+    # gang-health fields (docs/observability.rst): steps/sec
+    # distribution over repeated invocations of the measured
+    # executable (p99 = the slow tail, so p99 <= p50) and the HBM
+    # high-water from the same observe.health gauge exporter the
+    # heartbeat uses — null on deviceless hosts like this cpu rig
+    assert out["steps_per_sec_p50"] > 0
+    assert 0 < out["steps_per_sec_p99"] <= out["steps_per_sec_p50"]
+    assert out["hbm_high_water_bytes"] is None
 
 
 @pytest.mark.gang
