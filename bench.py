@@ -13,6 +13,14 @@ lease, so every accelerator touch is bounded):
 - parent (no jax import): probe subprocess with a hard timeout, one
   retry after a pause; then the measured run in a second subprocess
   with a generous-but-finite timeout, forwarding its JSON line.
+  Retries only happen when ``/dev/accel*`` exists — an absent chip
+  never appears, so a deviceless host fast-fails the probe in ONE
+  attempt and measures the **CPU proxy** instead: a small fixed-shape
+  llama-LoRA step on ``JAX_PLATFORMS=cpu``, reported as
+  ``llama_lora_train_tokens_per_sec_cpu_proxy`` against its own
+  committed baseline (BASELINE.json) — the perf trajectory stays
+  non-null on every host, and the on-chip metric stays primary when
+  hardware exists.
 - ``--probe``: initialize the backend, run one tiny op with a host
   readback, print the platform.
 - ``--run``: the actual measurement (single jitted lax.scan over
@@ -71,6 +79,18 @@ CACHE_PATH = os.path.join(
 
 METRIC = "llama_lora_train_tokens_per_sec_per_chip"
 UNIT = "tokens/sec/chip"
+
+# Deviceless-host headline (ROADMAP item 4, "un-null the perf
+# trajectory"): when no accelerator exists the bench measures a SMALL
+# FIXED-SHAPE llama-LoRA step on JAX_PLATFORMS=cpu and reports this
+# metric against its own committed baseline — every PR lands a real
+# number and CPU-visible regressions (dispatch overhead, recompiles,
+# input-pipeline stalls) become enforceable. The on-chip METRIC stays
+# primary whenever hardware exists. The proxy shape is frozen and
+# ignores promoted.json — its trajectory must stay comparable across
+# rounds even when the on-chip headline config is re-promoted.
+METRIC_CPU = "llama_lora_train_tokens_per_sec_cpu_proxy"
+UNIT_CPU = "tokens/sec (cpu proxy)"
 
 # Peak bf16 FLOPs/s for the chip MFU is computed against (v5e ≈ 197
 # TFLOPs; override for other chips).
@@ -173,16 +193,30 @@ def _lease_diagnostics():
     return sus
 
 
-def _baseline_value():
+def _baseline_value(metric=METRIC):
     """Frozen own-framework baseline from BASELINE.json (the reference
     publishes no numbers — BASELINE.md)."""
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BASELINE.json")
         with open(path) as f:
-            return json.load(f).get("published", {}).get(METRIC)
+            return json.load(f).get("published", {}).get(metric)
     except Exception:
         return None
+
+
+def _accel_devices_present():
+    """True when the host exposes accelerator device nodes — the
+    cheap pre-probe truth that decides whether probe retries can ever
+    help (a wedged lease clears; an absent chip never appears).
+    Deliberately broad (TPU ``/dev/accel*``, vfio-passthrough TPU
+    VMs, CUDA ``/dev/nvidia*``): a host with ANY of these keeps the
+    full retry schedule and never silently downgrades to the CPU
+    proxy on a transient probe failure."""
+    import glob
+
+    return bool(glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+                or glob.glob("/dev/nvidia*"))
 
 
 def _apply_platform_override():
@@ -318,12 +352,26 @@ def run():
     # below: a rerun after a probe retry deserializes and goes.
     cache_dir = enable_persistent_cache(_bench_compile_cache_dir())
 
-    promoted = _promoted_config()
+    cpu_proxy = bool(os.environ.get("SPARKDL_TPU_BENCH_CPU_PROXY"))
+    promoted = {} if cpu_proxy else _promoted_config()
     # flash_block rides LlamaConfig (part of the jit cache key), not
     # the env var (read once at attention-module import).
     flash_block = int(promoted.get("flash_block", 0))
     attention = promoted.get("attention", "reference")
-    if os.environ.get("SPARKDL_TPU_BENCH_TINY"):
+    n_steps = 20
+    if cpu_proxy:
+        # Deviceless-host headline: a FIXED small shape, big enough
+        # that the scanned step dominates dispatch, small enough that
+        # the whole measurement (warm + timed + p50/p99 reps) stays
+        # under a minute on one CPU. Frozen independently of
+        # promoted.json — see METRIC_CPU.
+        cfg = LlamaConfig(
+            vocab_size=4096, d_model=256, n_layers=4, n_heads=8,
+            n_kv_heads=4, d_ff=1024, dtype=jnp.bfloat16, lora_rank=8,
+        )
+        batch, seq = 4, 256
+        n_steps = 8
+    elif os.environ.get("SPARKDL_TPU_BENCH_TINY"):
         # CI smoke config: exercises the full measurement path in
         # seconds on cpu; numbers are not meaningful.
         cfg = LlamaConfig(
@@ -365,8 +413,6 @@ def run():
         "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                                jnp.int32),
     }
-
-    n_steps = 20
 
     # The whole measured loop lives inside ONE jitted program
     # (lax.scan over steps): per-dispatch RPC overhead through remote
@@ -466,16 +512,14 @@ def run():
     model_flops_per_sec = flops_per_token * tokens_per_sec
     mfu = model_flops_per_sec / PEAK_FLOPS
 
-    base = _baseline_value()
-    print(json.dumps({
-        "metric": METRIC,
+    base = _baseline_value(METRIC_CPU if cpu_proxy else METRIC)
+    rec = {
+        "metric": METRIC_CPU if cpu_proxy else METRIC,
         "value": round(tokens_per_sec, 1),
-        "unit": UNIT,
+        "unit": UNIT_CPU if cpu_proxy else UNIT,
         "vs_baseline": (round(tokens_per_sec / base, 3)
                         if base else 1.0),
         "platform": jax.devices()[0].platform,
-        "mfu": round(mfu, 4),
-        "model_tflops_per_sec": round(model_flops_per_sec / 1e12, 1),
         "last_loss": round(last_loss, 4),
         "compile_seconds": round(compile_seconds, 3),
         "warm_start": warm_start,
@@ -483,7 +527,14 @@ def run():
         "steps_per_sec_p99": round(steps_per_sec_p99, 3),
         "hbm_high_water_bytes": hbm_high_water,
         **({"promoted": promoted} if promoted else {}),
-    }))
+    }
+    if not cpu_proxy:
+        # MFU is computed against the CHIP's peak FLOPs — meaningless
+        # for the CPU proxy, whose contract is trajectory, not
+        # utilization.
+        rec["mfu"] = round(mfu, 4)
+        rec["model_tflops_per_sec"] = round(model_flops_per_sec / 1e12, 1)
+    print(json.dumps(rec))
 
 
 def _bounded_run(args, env, timeout):
@@ -527,8 +578,14 @@ def orchestrate():
             return None, "probe rc=%d: %s" % (rc, err.strip()[-400:])
         return out.strip().splitlines()[-1], None
 
+    # No /dev/accel* means no amount of probe retrying can help — the
+    # retry schedule exists for WEDGED leases, not ABSENT chips. One
+    # attempt, then the CPU-proxy fallback (ROADMAP item 4: BENCH_r01–
+    # r05 each burned ~10 minutes of retries on this deviceless
+    # container before recording value: null).
+    have_accel = _accel_devices_present()
     platform, err = attempt_probe()
-    for pause in PROBE_PAUSES_S:
+    for pause in (PROBE_PAUSES_S if have_accel else ()):
         if platform is not None:
             break
         holders = _lease_diagnostics()
@@ -555,7 +612,29 @@ def orchestrate():
         time.sleep(pause)
         platform, err = attempt_probe()
     if platform is None:
-        _fail(f"accelerator backend unavailable: {err}", allow_stale=True)
+        if not have_accel and not env.get("SPARKDL_TPU_BENCH_PLATFORM"):
+            # Probe died without device nodes and without an explicit
+            # platform pin (a site plugin wedging backend init, say):
+            # force cpu for the measured child — the CPU proxy is the
+            # deviceless contract either way.
+            sys.stderr.write(
+                f"bench: probe failed ({err}) with no /dev/accel* — "
+                "forcing the cpu backend for the proxy measurement\n")
+            env["SPARKDL_TPU_BENCH_PLATFORM"] = "cpu"
+            platform = "cpu"
+        else:
+            _fail(f"accelerator backend unavailable: {err}",
+                  allow_stale=True)
+
+    if platform == "cpu" and not env.get("SPARKDL_TPU_BENCH_TINY"):
+        # Deviceless host: measure the small fixed-shape CPU proxy
+        # instead of dragging the full on-chip config through a CPU
+        # (hours) or emitting null. TINY keeps its own path — CI uses
+        # it to exercise the on-chip measurement machinery on cpu.
+        env["SPARKDL_TPU_BENCH_CPU_PROXY"] = "1"
+        sys.stderr.write(
+            "bench: cpu backend — measuring the fixed-shape CPU-proxy "
+            f"headline ({METRIC_CPU})\n")
 
     sys.stderr.write(f"bench: backend healthy ({platform}); running\n")
     rc, out, err = _bounded_run(

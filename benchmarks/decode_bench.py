@@ -5,6 +5,13 @@ dense bf16 model AND the int8 weight-only variant (models/quant.py —
 decode is HBM-bound, int8 halves the weight read). Prints one JSON
 line per variant. Run on a TPU host; SPARKDL_TPU_BENCH_TINY=1 for a
 CPU smoke.
+
+Every record reports a RATE DISTRIBUTION over repeated timed runs —
+``value`` is the p50 and ``tokens_per_sec_p99`` the slow tail (the
+99th percentile of run latency, so p99 <= p50 by construction) —
+matching the ``steps_per_sec_p50/p99`` split ``bench.py`` reports: a
+single-shot number hides exactly the jitter (noisy neighbor, thermal
+throttle, host GC) a p99 exposes.
 """
 
 import dataclasses
@@ -15,8 +22,26 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+REPS = 3
 
-def measure(model, params, prompt, new, batch):
+
+def _rate_fields(rates):
+    """p50/p99 record fields from per-run tokens/sec samples. p99 is
+    the SLOW tail: the rate at the 99th percentile of run latency =
+    the 1st percentile of the rate samples (reciprocal is monotonic)."""
+    import numpy as np
+
+    return {
+        "value": round(float(np.percentile(rates, 50)), 1),
+        "tokens_per_sec_p50": round(float(np.percentile(rates, 50)), 1),
+        "tokens_per_sec_p99": round(float(np.percentile(rates, 1)), 1),
+        "reps": len(rates),
+    }
+
+
+def measure(model, params, prompt, new, batch, reps=REPS):
+    """Per-run tokens/sec samples over ``reps`` timed runs (one warm
+    run first so XLA compiles outside the measurement)."""
     import numpy as np
 
     from sparkdl_tpu.models.generate import generate
@@ -25,11 +50,13 @@ def measure(model, params, prompt, new, batch):
     out = generate(model, params, prompt, max_new_tokens=new)
     np.asarray(out)
 
-    t0 = time.perf_counter()
-    out = generate(model, params, prompt, max_new_tokens=new)
-    np.asarray(out)  # host readback = true sync
-    dt = time.perf_counter() - t0
-    return batch * new / dt
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = generate(model, params, prompt, max_new_tokens=new)
+        np.asarray(out)  # host readback = true sync
+        rates.append(batch * new / (time.perf_counter() - t0))
+    return rates
 
 
 def main():
@@ -62,10 +89,11 @@ def main():
     )
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
 
-    tps = measure(model, params, prompt, new, batch)
+    dense_fields = _rate_fields(measure(model, params, prompt, new, batch))
+    tps = dense_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_tokens_per_sec",
-        "value": round(tps, 1),
+        **dense_fields,
         "unit": "tokens/sec",
         "batch": batch, "prompt_len": p_len, "new_tokens": new,
         "platform": jax.devices()[0].platform,
@@ -75,10 +103,11 @@ def main():
     q_tree = jax.device_put(q_tree)  # keep the H2D upload out of the
     # timed run (the bf16 tree is already device-resident)
     cfg_q = dataclasses.replace(cfg, quant="int8")
-    tps_q = measure(Llama(cfg_q), q_tree, prompt, new, batch)
+    q_fields = _rate_fields(measure(Llama(cfg_q), q_tree, prompt, new, batch))
+    tps_q = q_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_int8_tokens_per_sec",
-        "value": round(tps_q, 1),
+        **q_fields,
         "unit": "tokens/sec",
         "batch": batch, "prompt_len": p_len, "new_tokens": new,
         "vs_bf16": round(tps_q / tps, 3),
@@ -90,10 +119,11 @@ def main():
     q4_tree = jax.device_put(quantize_llama_params(
         jax.tree.map(np.asarray, params), bits=4))
     cfg_q4 = dataclasses.replace(cfg, quant="int4")
-    tps_q4 = measure(Llama(cfg_q4), q4_tree, prompt, new, batch)
+    q4_fields = _rate_fields(measure(Llama(cfg_q4), q4_tree, prompt, new, batch))
+    tps_q4 = q4_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_int4_tokens_per_sec",
-        "value": round(tps_q4, 1),
+        **q4_fields,
         "unit": "tokens/sec",
         "batch": batch, "prompt_len": p_len, "new_tokens": new,
         "vs_bf16": round(tps_q4 / tps, 3),
@@ -111,21 +141,26 @@ def main():
     _, _ = speculative_generate(   # warm: compiles all three programs
         model, params, q_tree, prompt, max_new_tokens=spec_new, k=k,
         draft_model=Llama(cfg_q))
-    t0 = time.perf_counter()
-    out_s, stats = speculative_generate(
-        model, params, q_tree, prompt, max_new_tokens=spec_new, k=k,
-        draft_model=Llama(cfg_q))
-    np.asarray(out_s)
-    dt_s = time.perf_counter() - t0
+    spec_rates = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out_s, stats = speculative_generate(
+            model, params, q_tree, prompt, max_new_tokens=spec_new,
+            k=k, draft_model=Llama(cfg_q))
+        np.asarray(out_s)
+        spec_rates.append(
+            batch * spec_new / (time.perf_counter() - t0))
+    spec_fields = _rate_fields(spec_rates)
+    tps_spec = spec_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_speculative_tokens_per_sec",
-        "value": round(batch * spec_new / dt_s, 1),
+        **spec_fields,
         "unit": "tokens/sec",
         "k": k, "batch": batch, "new_tokens": spec_new,
         "acceptance_rate": round(
             stats["accepted"] / max(1, stats["proposed"]), 3),
         "rounds": stats["rounds"],
-        "vs_plain_bf16": round((batch * spec_new / dt_s) / tps, 3),
+        "vs_plain_bf16": round(tps_spec / tps, 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
@@ -158,18 +193,28 @@ def main():
             )
         return eng
 
-    # warm: compiles the prefill buckets + chunk programs; the timed
-    # engine reuses them (programs are cached module-level per config)
-    build_engine(1).run()
+    def engine_rates(build, reps=REPS):
+        """Repeated timed drains of the same request stream — a fresh
+        engine per rep (compiled programs are cached module-level per
+        config, so reps pay host scheduling + device time, the thing
+        being measured). Returns (rates, last engine, total tokens)."""
+        build(1).run()   # warm: compiles prefill buckets + chunk/round
+        rates, eng, total = [], None, 0
+        for _ in range(reps):
+            eng = build(1)
+            t0 = time.perf_counter()
+            results = eng.run()
+            dt = time.perf_counter() - t0
+            total = sum(len(v) for v in results.values())
+            rates.append(total / dt)
+        return rates, eng, total
 
-    eng = build_engine(1)
-    t0 = time.perf_counter()
-    results = eng.run()
-    dt = time.perf_counter() - t0
-    total_new = sum(len(v) for v in results.values())
+    cb_rates, eng, total_new = engine_rates(build_engine)
+    cb_fields = _rate_fields(cb_rates)
+    tps_cb = cb_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_continuous_batching_tokens_per_sec",
-        "value": round(total_new / dt, 1),
+        **cb_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "requests": len(reqs),
         "generated_tokens": total_new,
@@ -195,20 +240,17 @@ def main():
             )
         return eng
 
-    build_spec_engine(1).run()  # warm
-    eng_s = build_spec_engine(1)
-    t0 = time.perf_counter()
-    results_s = eng_s.run()
-    dt_s = time.perf_counter() - t0
-    total_s = sum(len(v) for v in results_s.values())
+    sb_rates, eng_s, _total_s = engine_rates(build_spec_engine)
+    sb_fields = _rate_fields(sb_rates)
     print(json.dumps({
         "metric": "llama_decode_spec_batching_tokens_per_sec",
-        "value": round(total_s / dt_s, 1),
+        **sb_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "k": spec_k, "requests": len(reqs),
         "acceptance_rate": round(eng_s.stats["acceptance_rate"], 3),
         "rounds": eng_s.stats["rounds"],
-        "vs_plain_engine": round((total_s / dt_s) / (total_new / dt), 3),
+        "vs_plain_engine": round(
+            sb_fields["tokens_per_sec_p50"] / tps_cb, 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
@@ -217,20 +259,18 @@ def main():
     # gather/scatter indirection (the payoff is pool-sized memory).
     page_size = 16 if os.environ.get("SPARKDL_TPU_BENCH_TINY") else 64
 
-    build_engine(1, page_size).run()  # warm
-    eng_p = build_engine(1, page_size)
-    t0 = time.perf_counter()
-    results_p = eng_p.run()
-    dt_p = time.perf_counter() - t0
-    total_p = sum(len(v) for v in results_p.values())
+    pg_rates, eng_p, _ = engine_rates(
+        lambda seed: build_engine(seed, page_size))
+    pg_fields = _rate_fields(pg_rates)
+    tps_pg = pg_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_paged_tokens_per_sec",
-        "value": round(total_p / dt_p, 1),
+        **pg_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
         "n_pages": eng_p.cfg.n_pages,
         "paged_kernel": eng_p.cfg.paged_kernel,
-        "vs_dense_engine": round((total_p / dt_p) / (total_new / dt), 3),
+        "vs_dense_engine": round(tps_pg / tps_cb, 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
 
@@ -238,18 +278,16 @@ def main():
     # between this and the record above is the paged-attention
     # kernel's win over the gather path (only meaningful on TPU,
     # where "auto" uses the kernel).
-    build_engine(1, page_size, paged_kernel="off").run()  # warm
-    eng_g = build_engine(1, page_size, paged_kernel="off")
-    t0 = time.perf_counter()
-    results_g = eng_g.run()
-    dt_g = time.perf_counter() - t0
-    total_g = sum(len(v) for v in results_g.values())
+    gt_fields = _rate_fields(engine_rates(
+        lambda seed: build_engine(seed, page_size,
+                                  paged_kernel="off"))[0])
     print(json.dumps({
         "metric": "llama_decode_paged_gather_tokens_per_sec",
-        "value": round(total_g / dt_g, 1),
+        **gt_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
-        "vs_paged_auto": round((total_g / dt_g) / (total_p / dt_p), 3),
+        "vs_paged_auto": round(
+            gt_fields["tokens_per_sec_p50"] / tps_pg, 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
 

@@ -1,0 +1,421 @@
+#!/usr/bin/env python
+"""Latency-under-load gate for the serving stack: N concurrent client
+streams against a live :class:`ServingFrontend`, reporting p50/p99
+time-to-first-token, p50/p99 inter-token latency, and aggregate
+tokens/sec — the ROADMAP item-1 acceptance bench.
+
+Two arrival models (``--mode``):
+
+- ``closed`` (default): each of ``--streams`` clients keeps exactly
+  one request in flight, sending the next the moment one finishes —
+  the classic closed-loop saturation measurement.
+- ``poisson``: open-loop Poisson arrivals at ``--rate`` requests/sec
+  across the whole fleet, each request on its own thread regardless of
+  how many are already in flight — the overload-behavior measurement
+  (closed loops self-throttle and hide queueing collapse).
+
+The bench is deliberately ALSO an end-to-end test of the serving
+observability layer (ISSUE 6): it exports
+``SPARKDL_TPU_TELEMETRY_DIR`` (when unset) so the frontend builds its
+:class:`~sparkdl_tpu.observe.serving.ServingTelemetry`, then
+
+- scrapes the server's own ``GET /metrics`` and reports the
+  server-side TTFT histogram estimate and the batch-utilization
+  time-average (``engine_batch_utilization_sum/_count``) next to the
+  client-measured numbers, failing if the instrument counts don't
+  match the requests actually served;
+- validates the run-dir artifacts after ``close()``: ``timeline.json``
+  must hold one ``request`` span per completed request and
+  ``metrics.prom`` the SLO series.
+
+Prints exactly ONE JSON line on stdout; exits nonzero on null
+percentiles, count mismatches, or malformed artifacts.
+``SPARKDL_TPU_BENCH_TINY=1`` selects a CPU-sized model;
+``SPARKDL_TPU_BENCH_PLATFORM=cpu`` pins the jax platform.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(values, q):
+    """Exact percentile of a non-empty list (numpy is already a hard
+    dependency of the model under test)."""
+    import numpy as np
+
+    return float(np.percentile(values, q))
+
+
+# -- Prometheus text parsing (scrape-side of the end-to-end check) ----------
+
+
+def parse_prom(text):
+    """{(name, (label tuples sorted)): value} over every sample line."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$",
+                     line)
+        if not m:
+            continue
+        name, _, labels_s, value = m.groups()
+        labels = ()
+        if labels_s:
+            labels = tuple(sorted(
+                (k, v) for k, v in re.findall(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                    labels_s)
+            ))
+        try:
+            out[(name, labels)] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def hist_quantile(samples, name, q, extra_labels=()):
+    """Histogram quantile estimate from ``<name>_bucket`` cumulative
+    counts (linear interpolation inside the bucket; the +Inf bucket
+    clamps to the last finite bound). None when the histogram is
+    empty or absent."""
+    buckets = []
+    for (n, labels), v in samples.items():
+        if n != name + "_bucket":
+            continue
+        ld = dict(labels)
+        if any(ld.get(k) != val for k, val in extra_labels):
+            continue
+        le = ld.get("le")
+        if le is None:
+            continue
+        buckets.append((float("inf") if le == "+Inf" else float(le), v))
+    if not buckets:
+        return None
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q / 100.0 * total
+    prev_upper, prev_cum = 0.0, 0.0
+    for upper, cum in buckets:
+        if cum >= target:
+            if upper == float("inf"):
+                return prev_upper  # best we can say: above the range
+            if cum == prev_cum:
+                return upper
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_upper + (upper - prev_upper) * frac
+        prev_upper, prev_cum = upper, cum
+    return prev_upper
+
+
+# -- client streams ----------------------------------------------------------
+
+
+class _RequestRecord:
+    __slots__ = ("t0", "ttft", "gaps", "tokens", "done_at", "error")
+
+    def __init__(self):
+        self.t0 = None
+        self.ttft = None
+        self.gaps = []
+        self.tokens = 0
+        self.done_at = None
+        self.error = None
+
+
+def _stream_one(address, prompt, max_new, rec, timeout):
+    """One SSE request, timed client-side: send -> first token (TTFT),
+    token -> token (inter-token gaps)."""
+    req = urllib.request.Request(
+        f"http://{address[0]}:{address[1]}/generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": max_new,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    rec.t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            last = None
+            for line in r:
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[6:])
+                now = time.perf_counter()
+                if "token" in ev:
+                    if last is None:
+                        rec.ttft = now - rec.t0
+                    else:
+                        rec.gaps.append(now - last)
+                    last = now
+                    rec.tokens += 1
+                elif "error" in ev:
+                    rec.error = ev["error"]
+                elif "done" in ev:
+                    rec.done_at = now
+    except Exception as e:  # count it, don't kill the bench
+        rec.error = str(e)
+
+
+def drive(address, *, streams, requests_per_stream, mode, rate,
+          prompt_len, max_new, vocab, timeout, seed=0):
+    """Run the load; returns (records, wall_seconds)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    total = streams * requests_per_stream
+    prompts = [rng.integers(1, vocab, (prompt_len,)).astype(int).tolist()
+               for _ in range(total)]
+    records = [_RequestRecord() for _ in range(total)]
+    t_start = time.perf_counter()
+    if mode == "closed":
+        def client(s):
+            for j in range(requests_per_stream):
+                i = s * requests_per_stream + j
+                _stream_one(address, prompts[i], max_new, records[i],
+                            timeout)
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:  # poisson open loop: fire at the schedule, never wait
+        gaps = rng.exponential(1.0 / rate, size=total)
+        threads = []
+        for i in range(total):
+            time.sleep(float(gaps[i]))
+            t = threading.Thread(
+                target=_stream_one,
+                args=(address, prompts[i], max_new, records[i], timeout))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+    return records, time.perf_counter() - t_start
+
+
+# -- run-dir artifact validation --------------------------------------------
+
+
+def check_artifacts(run_dir, completed):
+    """The end-to-end instrumentation check: the run dir the frontend
+    wrote on close() must tell the same story the clients measured.
+    Returns a list of problems (empty = ok)."""
+    problems = []
+    tl_path = os.path.join(run_dir, "timeline.json")
+    prom_path = os.path.join(run_dir, "metrics.prom")
+    try:
+        with open(tl_path) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable {tl_path}: {e}"]
+    spans = [e for e in trace.get("traceEvents", ())
+             if isinstance(e, dict) and e.get("name") == "request"
+             and e.get("ph") == "X"]
+    if len(spans) < completed:
+        problems.append(
+            f"timeline.json has {len(spans)} request spans, "
+            f"expected >= {completed}")
+    for ev in spans:
+        args = ev.get("args", {})
+        if args.get("rid") is None:
+            problems.append(f"request span without rid: {ev}")
+            break
+        if args.get("code") == 200 and args.get("ttft_s") is None:
+            problems.append(f"served request span without ttft_s: {ev}")
+            break
+    try:
+        with open(prom_path) as f:
+            prom = f.read()
+    except OSError as e:
+        return problems + [f"unreadable {prom_path}: {e}"]
+    for series in ("server_ttft_seconds_count",
+                   "server_inter_token_seconds_count",
+                   "engine_batch_utilization_count"):
+        if series not in prom:
+            problems.append(f"metrics.prom missing {series}")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--requests-per-stream", type=int, default=4)
+    ap.add_argument("--mode", choices=("closed", "poisson"),
+                    default="closed")
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="poisson arrivals/sec across the fleet")
+    ap.add_argument("--prompt-len", type=int, default=None)
+    ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--n-slots", type=int, default=None)
+    ap.add_argument("--page-size", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    # The bench IS the instrumentation's end-to-end test: opt in
+    # before the frontend latches, unless the operator already did.
+    os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        tempfile.mkdtemp(prefix="sparkdl-serve-bench-"))
+
+    plat = os.environ.get("SPARKDL_TPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import Llama, LlamaConfig
+    from sparkdl_tpu.models.server import ServingFrontend
+    from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+
+    tiny = bool(os.environ.get("SPARKDL_TPU_BENCH_TINY"))
+    if tiny:
+        cfg = LlamaConfig.tiny(max_cache_len=128)
+        n_slots = args.n_slots or 4
+        chunk, prompt_len = 4, args.prompt_len or 8
+        max_new = args.max_new or 16
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16,
+            max_cache_len=2048,
+        )
+        n_slots = args.n_slots or 8
+        chunk, prompt_len = 16, args.prompt_len or 64
+        max_new = args.max_new or 128
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = ContinuousBatchingEngine(
+        model, params, n_slots=n_slots, chunk=chunk,
+        page_size=args.page_size)
+    fe = ServingFrontend(engine).start()
+    problems = []
+    try:
+        if fe.request_telemetry is None:
+            problems.append("frontend built no ServingTelemetry "
+                            "(telemetry dir not latched?)")
+        # warm: compile the prefill bucket + chunk programs outside
+        # the measured window (XLA compile is not a latency SLO)
+        warm = _RequestRecord()
+        _stream_one(fe.address, [1] * prompt_len, max_new, warm,
+                    args.timeout)
+        if warm.error:
+            problems.append(f"warmup request failed: {warm.error}")
+
+        records, wall = drive(
+            fe.address, streams=args.streams,
+            requests_per_stream=args.requests_per_stream,
+            mode=args.mode, rate=args.rate, prompt_len=prompt_len,
+            max_new=max_new, vocab=cfg.vocab_size,
+            timeout=args.timeout,
+        )
+        done = [r for r in records if r.ttft is not None and not r.error]
+        failed = [r for r in records if r.error]
+        ttfts = [r.ttft for r in done]
+        gaps = [g for r in done for g in r.gaps]
+        total_tokens = sum(r.tokens for r in done)
+
+        # server-side cross-check: scrape /metrics BEFORE close
+        with urllib.request.urlopen(
+                f"http://{fe.address[0]}:{fe.address[1]}/metrics",
+                timeout=60) as r:
+            prom = parse_prom(r.read().decode())
+        served = 1 + len(done)  # warmup included
+        srv_ttft_count = prom.get(("server_ttft_seconds_count", ()), 0)
+        if srv_ttft_count < served:
+            problems.append(
+                f"server_ttft_seconds_count {srv_ttft_count} < "
+                f"{served} served requests — instrumentation dropped "
+                "requests")
+        util_sum = prom.get(("engine_batch_utilization_sum", ()))
+        util_count = prom.get(("engine_batch_utilization_count", ()))
+        util_avg = (util_sum / util_count if util_sum is not None
+                    and util_count else None)
+        server = {
+            "ttft_count": srv_ttft_count,
+            "ttft_p50_s_est": hist_quantile(
+                prom, "server_ttft_seconds", 50),
+            "ttft_p99_s_est": hist_quantile(
+                prom, "server_ttft_seconds", 99),
+            "inter_token_p50_s_est": hist_quantile(
+                prom, "server_inter_token_seconds", 50),
+            "queue_wait_p50_s_est": hist_quantile(
+                prom, "server_queue_wait_seconds", 50),
+            "generated_tokens": prom.get(
+                ("server_generated_tokens_total", ())),
+        }
+    finally:
+        fe.close()
+
+    run_dir = (fe.request_telemetry.run_dir
+               if fe.request_telemetry is not None else None)
+    if run_dir:
+        problems += check_artifacts(run_dir, len(done))
+    else:
+        problems.append("no run dir written")
+
+    record = {
+        "metric": "serve_latency_under_load",
+        "mode": args.mode,
+        "streams": args.streams,
+        "requests": len(records),
+        "completed": len(done),
+        "failed": len(failed),
+        "ttft_p50_s": (round(_percentile(ttfts, 50), 4)
+                       if ttfts else None),
+        "ttft_p99_s": (round(_percentile(ttfts, 99), 4)
+                       if ttfts else None),
+        "inter_token_p50_s": (round(_percentile(gaps, 50), 5)
+                              if gaps else None),
+        "inter_token_p99_s": (round(_percentile(gaps, 99), 5)
+                              if gaps else None),
+        "tokens_per_sec": (round(total_tokens / wall, 1)
+                           if wall > 0 and total_tokens else None),
+        "batch_utilization_avg": (round(util_avg, 4)
+                                  if util_avg is not None else None),
+        "n_slots": n_slots,
+        "chunk": chunk,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "server": server,
+        "run_dir": run_dir,
+        "platform": jax.devices()[0].platform,
+    }
+    if failed:
+        record["errors"] = sorted({r.error for r in failed})[:3]
+    if len(done) < len(records):
+        problems.append(
+            f"only {len(done)}/{len(records)} requests completed")
+    for key in ("ttft_p50_s", "ttft_p99_s", "inter_token_p50_s",
+                "inter_token_p99_s", "tokens_per_sec",
+                "batch_utilization_avg"):
+        if record[key] is None:
+            problems.append(f"null {key}")
+    if problems:
+        record["problems"] = problems
+    print(json.dumps(record), flush=True)
+    if problems:
+        for p in problems:
+            print(f"serve_bench: FAIL: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

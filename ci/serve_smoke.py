@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""CI serving latency-under-load smoke (ISSUE 6 satellite): run
+``benchmarks/serve_bench.py`` with a tiny CPU model at small
+concurrency and FAIL the build on null percentiles or malformed run
+artifacts. The bench itself already cross-checks the client-measured
+numbers against the server's own ``/metrics`` and validates the
+run-dir artifacts — this wrapper adds the build-level contract (one
+parseable JSON line, non-null SLO numbers, artifacts present where
+the workflow's upload-artifact step expects them) and runs
+``observe.doctor`` over the run dir so the serving postmortem rides
+the build artifacts too.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/serve_smoke.py``
+(defaults the dir to ``./serve-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fail(msg):
+    print(f"SERVE SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "serve-artifacts"),
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    env = dict(os.environ)
+    env.setdefault("SPARKDL_TPU_BENCH_TINY", "1")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "serve_bench.py"),
+         "--streams", "4", "--requests-per-stream", "2",
+         "--max-new", "12"],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    sys.stderr.write(r.stderr[-4000:])
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    if len(lines) != 1:
+        fail(f"expected exactly one JSON line, got {len(lines)}: "
+             f"{r.stdout[-1000:]}")
+    try:
+        rec = json.loads(lines[0])
+    except ValueError as e:
+        fail(f"unparseable bench output: {e}: {lines[0][:400]}")
+    # keep the record next to the run dir for upload-artifact
+    bench_json = os.path.join(out_dir, "serve-bench.json")
+    with open(bench_json, "w") as f:
+        f.write(lines[0] + "\n")
+    if r.returncode != 0:
+        fail(f"serve_bench exited {r.returncode}: "
+             f"{rec.get('problems')}")
+    for key in ("ttft_p50_s", "ttft_p99_s", "inter_token_p50_s",
+                "inter_token_p99_s", "tokens_per_sec",
+                "batch_utilization_avg"):
+        if not isinstance(rec.get(key), (int, float)):
+            fail(f"null/missing {key} in {lines[0][:400]}")
+    if rec["completed"] != rec["requests"]:
+        fail(f"only {rec['completed']}/{rec['requests']} completed")
+
+    run_dir = rec.get("run_dir")
+    if not run_dir or not os.path.isdir(run_dir):
+        fail(f"run dir missing: {run_dir!r}")
+    for name in ("timeline.json", "metrics.prom", "metrics.json"):
+        path = os.path.join(run_dir, name)
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            fail(f"missing/empty artifact {path}")
+    with open(os.path.join(run_dir, "timeline.json")) as f:
+        trace = json.load(f)
+    spans = [e for e in trace.get("traceEvents", ())
+             if isinstance(e, dict) and e.get("name") == "request"
+             and e.get("ph") == "X"]
+    if len(spans) < rec["completed"]:
+        fail(f"timeline has {len(spans)} request spans for "
+             f"{rec['completed']} completed requests")
+
+    # the doctor must read a serving run dir and exit 0 (no hang);
+    # keep its report with the artifacts
+    d = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run_dir],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=REPO,
+    )
+    with open(os.path.join(out_dir, "doctor-report.txt"), "w") as f:
+        f.write(d.stdout + d.stderr)
+    if d.returncode != 0:
+        fail(f"doctor exited {d.returncode} on the serving run dir:\n"
+             f"{d.stdout}\n{d.stderr}")
+    if "serving:" not in d.stdout:
+        fail(f"doctor report lacks the serving section:\n{d.stdout}")
+
+    print("serve smoke OK:", json.dumps({
+        k: rec[k] for k in ("ttft_p50_s", "ttft_p99_s",
+                            "inter_token_p50_s", "inter_token_p99_s",
+                            "tokens_per_sec", "batch_utilization_avg")
+    }))
+    print("doctor:", d.stdout.splitlines()[0] if d.stdout else "")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

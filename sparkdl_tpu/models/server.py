@@ -42,6 +42,19 @@ engine thread, sampled at scrape), ``server_request_seconds{code=...}``
 (admission → response), and ``server_first_token_seconds`` (admission
 → first generated token, the streaming-latency SLO).
 
+Request-level SLO tracing: when ``SPARKDL_TPU_TELEMETRY_DIR`` is set
+(the PR-3 opt-in latch), the frontend additionally builds a
+:class:`sparkdl_tpu.observe.serving.ServingTelemetry` — a per-request
+span tree (submit → admit → first_token → done) on the gang timeline,
+SLO histograms (``server_ttft_seconds``,
+``server_inter_token_seconds``, ``server_queue_wait_seconds``,
+``server_tokens_per_sec``) on this same registry, and engine-internal
+utilization gauges via ``engine.telemetry`` — and writes training-
+gang-shaped run artifacts (``timeline.json`` + ``metrics.prom`` +
+``metrics.json`` + a crash-surviving flight-recorder ring) on
+``close()``. Without the env, ``request_telemetry`` stays ``None``
+and the serving hot path performs zero observe work per token.
+
 Error classification (clients and load balancers must be able to
 tell bad input from a sick server): request-validation failures are
 **400**; an engine ``run()`` fault on admitted requests is **500**;
@@ -60,6 +73,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from sparkdl_tpu import observe
 from sparkdl_tpu.observe.metrics import Registry
 
 
@@ -115,6 +129,16 @@ class ServingFrontend:
         # registry is the frontend's own (explicitly constructed), not
         # the env-gated gang telemetry facade.
         self.metrics = Registry()
+        # Per-request SLO tracing rides the PR-3 latch: only an
+        # explicit SPARKDL_TPU_TELEMETRY_DIR buys the span tree, the
+        # SLO histograms, and the engine utilization hooks — otherwise
+        # both stay None and the token path does no observe work.
+        self.request_telemetry = None
+        if observe.enabled():
+            from sparkdl_tpu.observe.serving import ServingTelemetry
+
+            self.request_telemetry = ServingTelemetry(self.metrics)
+            self.engine.telemetry = self.request_telemetry
         self._arrivals = queue.Queue()   # (request dict, _Mailbox)
         self._live = {}                  # rid -> _Mailbox
         self._shutdown = threading.Event()
@@ -208,10 +232,19 @@ class ServingFrontend:
                             f"({frontend.engine.cfg.max_cache_len})")
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
+                    rt = frontend.request_telemetry
+                    if rt is not None:
+                        rt.request_rejected(400, "invalid_request")
                     frontend._record_request(400, t0)
                     self.send_error(400, _status_safe(e))
                     return
                 box = _Mailbox()
+                rt = frontend.request_telemetry
+                if rt is not None:
+                    rt.request_arrived(
+                        box, len(parsed["tokens"]),
+                        parsed["max_new_tokens"],
+                        bool(req.get("stream")))
                 frontend._arrivals.put((parsed, box))
                 frontend._wake.set()
                 if req.get("stream"):
@@ -312,16 +345,21 @@ class ServingFrontend:
                 req, box = self._arrivals.get_nowait()
             except queue.Empty:
                 return
+            rt = self.request_telemetry
             try:
                 rid = self.engine.submit(
                     req["tokens"], req["max_new_tokens"],
                     stop=req["stop"],
                 )
                 self._live[rid] = box
+                if rt is not None:
+                    rt.request_submitted(rid, box)
             except (ValueError, TypeError) as e:
                 # backstop: do_POST pre-validates, but engine-specific
                 # constraints (adapters, prefixes) can still refuse —
                 # that refusal is about the REQUEST, hence 400
+                if rt is not None:
+                    rt.request_rejected(400, "engine_refused")
                 box.fail(400, str(e))
 
     def _engine_loop(self):
@@ -334,11 +372,16 @@ class ServingFrontend:
             # died), so the client should retry against another
             # replica — a load balancer treats 503 as "drain me".
             self._poll_queue(self.engine)  # pull stragglers out of
-            for box in self._live.values():    # _arrivals first
+            rt = self.request_telemetry        # _arrivals first
+            for rid, box in self._live.items():
+                if rt is not None:
+                    rt.request_done(rid, code=503)
                 box.fail(503, "server shutting down")
             self._live.clear()
 
     def _serve_bursts(self):
+        rt = self.request_telemetry
+
         def on_token(rid, tok):
             box = self._live.get(rid)
             if box is not None:
@@ -347,6 +390,8 @@ class ServingFrontend:
                     self.metrics.histogram(
                         "server_first_token_seconds"
                     ).observe(time.perf_counter() - box.t0)
+                if rt is not None:
+                    rt.token(rid)
                 box.tokens.put(int(tok))
 
         while not self._shutdown.is_set():
@@ -359,7 +404,9 @@ class ServingFrontend:
                 results = self.engine.run(progress=self._poll_queue,
                                           on_token=on_token)
             except Exception as e:  # engine fault: fail the waiters
-                for box in self._live.values():   # and keep serving
+                for rid, box in self._live.items():  # and keep serving
+                    if rt is not None:
+                        rt.request_done(rid, code=500)
                     # 500: the ENGINE broke mid-run on a request the
                     # validator admitted — the client sent nothing
                     # wrong, and a 400 here would teach callers to
@@ -376,6 +423,8 @@ class ServingFrontend:
                 box = self._live.pop(rid, None)
                 if box is None:
                     continue
+                if rt is not None:
+                    rt.request_done(rid, code=200)
                 box.result = (
                     toks.tolist(),
                     self.engine.finish_reasons.get(rid, "length"),
@@ -387,6 +436,10 @@ class ServingFrontend:
     # -- lifecycle ----------------------------------------------------
 
     def start(self):
+        if self.request_telemetry is not None:
+            # long-running boxes keep their run dir current (and the
+            # event buffer drained) via periodic writes
+            self.request_telemetry.start_writer()
         self._engine_thread.start()
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, name="sparkdl-http",
@@ -400,3 +453,10 @@ class ServingFrontend:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._engine_thread.join(timeout=30)
+        if self.request_telemetry is not None:
+            # the engine thread has drained: render the run's
+            # Perfetto trace + Prometheus artifacts, then release the
+            # flight-recorder ring (which survives a SIGKILL that
+            # never reaches this line — the doctor reads the ring)
+            self.request_telemetry.write()
+            self.request_telemetry.close()

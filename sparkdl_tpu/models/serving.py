@@ -333,6 +333,12 @@ class ContinuousBatchingEngine:
         self._next_id = 0
         self.stats = {"steps": 0, "active_slot_steps": 0,
                       "total_slot_steps": 0}
+        # Request-level observability hook (a ServingTelemetry from
+        # sparkdl_tpu.observe.serving, installed by the HTTP frontend
+        # only when SPARKDL_TPU_TELEMETRY_DIR opted in). None keeps the
+        # decode loop's hot path at ONE `is not None` test per chunk —
+        # the zero-overhead contract the serving latch test pins.
+        self.telemetry = None
 
         # Device state: batched (or pooled paged) cache, per-slot
         # position, last token.
@@ -597,6 +603,10 @@ class ContinuousBatchingEngine:
         if need > len(self._free_pages):
             return False
         self._queue.pop(0)
+        if self.telemetry is not None:
+            # queue wait ends HERE — the engine is about to spend
+            # prefill compute on this request
+            self.telemetry.request_admitted(rid)
         own = [self._free_pages.pop() for _ in range(need)]
         self._slot_pages[slot_idx] = own
         self._tables[slot_idx] = 0
@@ -721,6 +731,8 @@ class ContinuousBatchingEngine:
 
     def _admit(self, slot_idx):
         rid, prompt, max_new, prefix_id, adapter_id = self._queue.pop(0)
+        if self.telemetry is not None:
+            self.telemetry.request_admitted(rid)
         p_len = len(prompt)
         self._rng, sub = jax.random.split(self._rng)
         if prefix_id is not None:
@@ -823,6 +835,7 @@ class ContinuousBatchingEngine:
             self.stats["steps"] += n
             self.stats["total_slot_steps"] += n * self.n_slots
             self.stats["active_slot_steps"] += int(active.sum()) * n
+            self._observe_chunk(int(active.sum()), n)
             for i, s in enumerate(self._slots):
                 if s.active:
                     self._accept_tokens(i, toks[:, i], lps[:, i])
@@ -839,6 +852,11 @@ class ContinuousBatchingEngine:
                     and self._queue):
                 if self.page_size:
                     if not self._try_admit_paged(i):
+                        if self.telemetry is not None:
+                            # requeued, not refused: the pool can't
+                            # cover the head's worst case yet
+                            self.telemetry.admission_deferred(
+                                "pool_exhausted")
                         break
                 else:
                     self._admit(i)
@@ -848,6 +866,18 @@ class ContinuousBatchingEngine:
         for i in list(self._prefilling):
             self._advance_prefill(i)
         return np.array([s.active for s in self._slots])
+
+    def _observe_chunk(self, active_count, n_tokens):
+        """Telemetry for one decode chunk (or speculation round) —
+        ONE definition for both decode loops, so utilization metrics
+        can never skew between the plain and speculative engines."""
+        if self.telemetry is not None:
+            self.telemetry.decode_chunk(
+                active_count, self.n_slots, n_tokens,
+                free_pages=(len(self._free_pages)
+                            if self.page_size else None),
+                n_pages=(self.cfg.n_pages if self.page_size else None),
+            )
 
     def _deadend_check(self):
         """Nothing active: raise when the queue head can NEVER admit
@@ -1260,6 +1290,9 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             self.stats["steps"] += 1
             self.stats["total_slot_steps"] += self.n_slots
             self.stats["active_slot_steps"] += n_act
+            # one speculation round = one "chunk" of up to k+1 tokens
+            # per slot
+            self._observe_chunk(n_act, self.k + 1)
             new_pos = np.asarray(self._pos).copy()
             new_tok = np.asarray(self._token).copy()
             for i, s in enumerate(self._slots):

@@ -22,6 +22,17 @@ exit code is the alerting contract: **nonzero when a hang verdict is
 found** (CI's hang smoke asserts it), zero for a clean run, 2 when the
 directory has no readable artifacts at all.
 
+Serving run dirs (written by a
+:class:`~sparkdl_tpu.models.server.ServingFrontend` with telemetry
+opted in) get their own postmortem section: the slowest requests by
+time-to-first-token, the admission-rejection/deferral breakdown, and
+the batch-utilization summary — read from the same
+``timeline.json``/``metrics.json`` shapes the gang artifacts use. A
+server that died by SIGKILL stopped writing artifacts mid-story (or
+never wrote any); the doctor merges the PR-5 flight-recorder ring
+left in the run dir into the timeline — every ring event the written
+trace is missing is the tail the kill cut off.
+
 Deliberately artifact-only: no jax, no control plane, no live gang —
 the doctor must run on a laptop against a copied run dir and reproduce
 the verdict from the files alone.
@@ -56,13 +67,15 @@ def _fmt_bytes(n):
 
 def _series_by_rank(metrics_doc):
     """rank-label -> {counters: {(name, label-items): v},
-    gauges: {...}} from metrics.json."""
+    gauges: {...}, histograms: {...: {"sum", "count"}}} from
+    metrics.json."""
     out = {}
     for series in (metrics_doc or {}).get("series", ()):
         rank = series.get("labels", {}).get("rank")
         if rank is None:
             continue
-        ranks = out.setdefault(rank, {"counters": {}, "gauges": {}})
+        ranks = out.setdefault(
+            rank, {"counters": {}, "gauges": {}, "histograms": {}})
         for kind in ("counters", "gauges"):
             for s in series.get(kind, ()):
                 labels = {k: v for k, v in s.get("labels", {}).items()
@@ -70,6 +83,12 @@ def _series_by_rank(metrics_doc):
                 key = (s.get("name"),
                        tuple(sorted(labels.items())))
                 ranks[kind][key] = s.get("value")
+        for s in series.get("histograms", ()):
+            labels = {k: v for k, v in s.get("labels", {}).items()
+                      if k != "rank"}
+            key = (s.get("name"), tuple(sorted(labels.items())))
+            ranks["histograms"][key] = {
+                "sum": s.get("sum"), "count": s.get("count")}
     return out
 
 
@@ -79,17 +98,98 @@ def _gauge(rank_series, name, **labels):
     )
 
 
+def _diagnose_serving(events, by_rank, top_n=5):
+    """Serving-run section (or None for pure gang dirs): slowest
+    requests by TTFT, the admission rejection/deferral breakdown, and
+    the batch-utilization summary — sourced from the ``cat="serving"``
+    span tree plus the ``server_*``/``engine_*`` metric series a
+    :class:`~sparkdl_tpu.models.server.ServingFrontend` run leaves."""
+    req_spans = [e for e in events
+                 if e.get("cat") == "serving"
+                 and e.get("name") == "request" and e.get("ph") == "X"]
+    srv = {}
+    for series in by_rank.values():
+        for kind in ("counters", "gauges", "histograms"):
+            for (name, labels), v in series.get(kind, {}).items():
+                if name.startswith(("server_", "engine_")):
+                    srv.setdefault(kind, {})[(name, labels)] = v
+    if not req_spans and not srv:
+        return None
+
+    by_code = {}
+    for (name, labels), v in srv.get("counters", {}).items():
+        if name == "server_requests_total":
+            by_code[dict(labels).get("code", "?")] = int(v)
+    rejections = {}
+    for (name, labels), v in srv.get("counters", {}).items():
+        if name in ("server_admission_rejections_total",
+                    "engine_admission_deferrals_total"):
+            reason = dict(labels).get("reason", "?")
+            if name.startswith("engine_"):
+                reason += " (deferred, requeued)"
+            rejections[reason] = int(v)
+
+    slowest = sorted(
+        (e.get("args", {}) for e in req_spans
+         if e.get("args", {}).get("ttft_s") is not None),
+        key=lambda a: a["ttft_s"], reverse=True,
+    )[:top_n]
+    slowest = [{k: a.get(k) for k in
+                ("rid", "ttft_s", "queue_wait_s", "tokens",
+                 "tokens_per_sec", "code", "prompt_len")}
+               for a in slowest]
+
+    util = srv.get("histograms", {}).get(("engine_batch_utilization", ()))
+    utilization = None
+    if util and util.get("count"):
+        utilization = {
+            "mean": round(util["sum"] / util["count"], 4),
+            "chunks": int(util["count"]),
+        }
+    return {
+        "requests": len(req_spans),
+        "by_code": by_code,
+        "slowest_requests_by_ttft": slowest,
+        "admission_rejections": rejections,
+        "batch_utilization": utilization,
+    }
+
+
 def diagnose(run_dir):
     """Build the structured diagnosis dict for one run dir, or None
     when the directory holds no recognizable artifacts."""
     timeline = _load_json(os.path.join(run_dir, "timeline.json"))
     metrics = _load_json(os.path.join(run_dir, "metrics.json"))
     health = _load_json(os.path.join(run_dir, "health.json"))
-    if timeline is None and metrics is None and health is None:
+    # Crash path: a process SIGKILLed between artifact writes (a
+    # serving frontend killed mid-burst — or before its first write,
+    # leaving no timeline.json at all) still mirrored its newest
+    # events into the flight-recorder ring in the run dir. Recover
+    # the tail straight from the mmap file and MERGE it: any ring
+    # event not already in timeline.json is story the kill cut off
+    # (flightrec has no jax; the doctor stays artifact-only).
+    from sparkdl_tpu.observe.flightrec import recover_job_dir
+
+    ring_events = []
+    for evs in recover_job_dir(run_dir).values():
+        ring_events.extend(e for e in evs if isinstance(e, dict))
+    if (timeline is None and metrics is None and health is None
+            and not ring_events):
         return None
 
     events = [e for e in (timeline or {}).get("traceEvents", ())
               if isinstance(e, dict) and e.get("ph") != "M"]
+
+    def _ev_key(e):
+        # stable under the ring's oversized-args truncation (which
+        # keeps name/ph/ts/tid) — dedupe must not resurrect events
+        # the timeline already has in full
+        return (e.get("ts"), e.get("name"), e.get("tid"), e.get("ph"))
+
+    seen = {_ev_key(e) for e in events}
+    ring_fresh = [e for e in ring_events
+                  if e.get("ph") != "M" and _ev_key(e) not in seen]
+    events.extend(ring_fresh)
 
     def named(name):
         return [e for e in events if e.get("name") == name]
@@ -180,6 +280,9 @@ def diagnose(run_dir):
 
     return {
         "run_dir": run_dir,
+        "recovered_from_flight_recorder": bool(ring_fresh),
+        "flight_recorder_recovered_events": len(ring_fresh),
+        "serving": _diagnose_serving(events, by_rank),
         "hang": verdict is not None,
         "verdict": verdict,
         "stalled_ranks": sorted(stalled),
@@ -241,6 +344,39 @@ def render_text(diag):
     if diag["chaos_injections"]:
         lines.append("chaos injections on the timeline: "
                      + ", ".join(diag["chaos_injections"]))
+    if diag.get("recovered_from_flight_recorder"):
+        lines.append(
+            f"NOTE: {diag.get('flight_recorder_recovered_events')} "
+            "event(s) recovered from the flight-recorder ring "
+            "(the process died before its final artifact write)")
+    srv = diag.get("serving")
+    if srv:
+        codes = ", ".join(f"{c}: {n}" for c, n in
+                          sorted(srv["by_code"].items()))
+        lines.append(f"serving: {srv['requests']} traced request(s)"
+                     + (f" ({codes})" if codes else ""))
+        if srv["slowest_requests_by_ttft"]:
+            lines.append("  slowest requests by TTFT:")
+            for r in srv["slowest_requests_by_ttft"]:
+                extra = ""
+                if r.get("queue_wait_s") is not None:
+                    extra += f", queued {r['queue_wait_s'] * 1e3:.1f} ms"
+                if r.get("tokens_per_sec"):
+                    extra += f", {r['tokens_per_sec']:.1f} tok/s"
+                lines.append(
+                    f"    rid {r.get('rid')}: "
+                    f"ttft {r['ttft_s'] * 1e3:.1f} ms"
+                    f" ({r.get('tokens')} tok, code {r.get('code')}"
+                    f"{extra})")
+        if srv["admission_rejections"]:
+            lines.append("  admission rejections: " + "; ".join(
+                f"{reason}: {n}" for reason, n in
+                sorted(srv["admission_rejections"].items())))
+        util = srv.get("batch_utilization")
+        if util:
+            lines.append(
+                f"  batch utilization: {util['mean']:.2f} mean over "
+                f"{util['chunks']} decode chunk(s)")
     return "\n".join(lines)
 
 

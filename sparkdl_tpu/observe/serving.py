@@ -1,0 +1,390 @@
+"""Request-level serving telemetry: per-request SLO tracing through
+the continuous-batching engine, plus the run-dir artifacts a serving
+box leaves behind.
+
+Training gangs got their observability in PRs 3 and 5 (metrics
+registry, merged timeline, flight recorder, doctor); this module gives
+the SERVING path the same treatment. One :class:`ServingTelemetry`
+instance rides a :class:`~sparkdl_tpu.models.server.ServingFrontend`
+and instruments the full request lifecycle::
+
+    do_POST -> queue wait -> engine admission -> prefill ->
+    per-chunk decode -> first token -> completion
+
+**Opt-in latch (the PR-3 contract):** the frontend constructs a
+ServingTelemetry only when ``SPARKDL_TPU_TELEMETRY_DIR`` is set.
+Without it, ``frontend.request_telemetry`` and ``engine.telemetry``
+stay ``None`` and the hot path performs ZERO observe work per token —
+every hook site is one ``is not None`` test (pinned by test the same
+way PR 5 pinned heartbeat thread names).
+
+**Span tree** (Chrome trace, ``cat="serving"``), keyed by request id —
+each request renders as its own track (``tid = rid``) so the tree
+reads per-request in Perfetto, and the instants are ordered
+``request.submit <= request.admit <= request.first_token <=
+request.done``::
+
+    request                  X  arrival -> generation done
+      request.queue_wait     X  arrival -> slot admission
+      request.submit         i  handed to engine.submit
+      request.admit          i  engine started prefilling it
+      request.first_token    i  first generated token (args: ttft_s)
+      request.done           i  finished (args: code, tokens,
+                                tokens_per_sec)
+    request.reject           i  refused before admission (args: code,
+                                reason; no rid — it never got one)
+
+**SLO metrics** (recorded into the frontend's own always-on registry,
+so they ride the existing ``GET /metrics``):
+
+- ``server_ttft_seconds`` — arrival -> first token;
+- ``server_inter_token_seconds`` — gap between consecutive tokens of
+  one request (the streaming jitter SLO);
+- ``server_queue_wait_seconds`` — arrival -> the engine starting
+  admission (prefill) for the request;
+- ``server_tokens_per_sec`` — per-request decode rate histogram;
+- ``server_generated_tokens_total`` — aggregate token counter;
+- ``server_admission_rejections_total{reason=...}`` — requests
+  refused before admission (``invalid_request``, ``engine_refused``).
+
+**Engine-internal gauges** (why latency moved — fed by the engine's
+``telemetry`` hooks in :mod:`sparkdl_tpu.models.serving`):
+
+- ``engine_batch_utilization`` — active slots / n_slots, observed once
+  per decode chunk (its ``_sum/_count`` is the time-average the
+  latency-under-load bench reports);
+- ``engine_active_slots`` / ``engine_slot_occupancy`` — slots busy at
+  the last chunk (count and fraction);
+- ``engine_kv_page_occupancy`` — used pages / pool (paged cache only);
+- ``engine_decode_chunks_total`` / ``engine_decode_tokens_total`` —
+  decode chunks and tokens dispatched (dispatched minus accepted
+  ``server_generated_tokens_total`` = host-discarded overshoot);
+- ``engine_admission_deferrals_total{reason=...}`` — admissions
+  capacity-deferred (``pool_exhausted``), requeued not refused.
+
+**Run artifacts:** :meth:`write` leaves the SAME artifact set a
+training gang's launcher writes — ``timeline.json`` (one "server"
+lane plus one track per request), ``metrics.prom`` / ``metrics.json``
+(series labeled ``rank="server"``) — under a fresh
+``SPARKDL_TPU_TELEMETRY_DIR/run-<pid>-<n>/`` dir, and mirrors every
+event into a PR-5 flight-recorder ring in that dir, so a SIGKILLed
+server's request tail is recoverable post-mortem
+(``observe.doctor`` reads the ring when ``timeline.json`` never got
+written).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+from sparkdl_tpu.observe.metrics import render_json, render_prometheus
+from sparkdl_tpu.observe.timeline import chrome_trace
+
+SERVER_LABEL = "server"
+
+# Periodic artifact writes for long-running servers (seconds; <= 0
+# disables the writer thread — close() still writes once).
+WRITE_S_ENV = "SPARKDL_TPU_SERVING_WRITE_S"
+DEFAULT_WRITE_S = 30.0
+
+# Retained-trace cap: a serving box runs indefinitely (unlike a gang
+# launch), so the re-rendered timeline keeps the NEWEST N events and
+# counts what it dropped (the metrics registry is cumulative and never
+# drops anything).
+MAX_EVENTS_ENV = "SPARKDL_TPU_SERVING_TRACE_EVENTS"
+DEFAULT_MAX_EVENTS = 100_000
+
+# Per-request decode rates span tiny CPU rigs (a few tok/s) through
+# batched TPU serving (thousands) — the latency DEFAULT_BUCKETS would
+# dump every sample in +Inf.
+RATE_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+# Utilization lives in [0, 1]; sixteenths resolve a slot at n_slots<=16
+# and the _sum/_count average is exact regardless of layout.
+UTIL_BUCKETS = tuple(i / 16 for i in range(1, 17))
+
+
+class ServingTelemetry:
+    """One serving run's request instrumentation + artifact writer.
+
+    ``registry`` is the frontend's own (always-on) metrics registry —
+    SLO series land next to the request-class counters on the same
+    ``GET /metrics``. The timeline is this instance's OWN
+    :class:`~sparkdl_tpu.observe.timeline.Timeline` (not the
+    process-global one): a frontend hosted inside a telemetry-enabled
+    gang worker must not steal events the worker's flusher would ship
+    to the driver, and two frontends in one process must not drain
+    each other — the serving run dir owns exactly its own story. The
+    flight-recorder mirror hangs off this private timeline's
+    ``observer`` hook, so no process-global observer is touched
+    either.
+
+    Threading: per-request state (``_req``) is touched only on the
+    engine thread (submit/admit/token/done all run there — the
+    frontend's ``_poll_queue`` and result loop included); arrival and
+    rejection hooks run on handler threads but touch only the
+    thread-safe registry/timeline and the request's own mailbox.
+    """
+
+    def __init__(self, registry, run_dir=None, max_events=None):
+        from sparkdl_tpu import observe
+        from sparkdl_tpu.observe.flightrec import FlightRecorder, ring_path
+        from sparkdl_tpu.observe.timeline import Timeline
+
+        self.registry = registry
+        self.timeline = Timeline()
+        self.run_dir = run_dir or observe.new_run_dir()
+        self._events = []        # drained-but-retained (rewrites render all)
+        self._closed = False
+        try:
+            self.max_events = int(
+                max_events if max_events is not None
+                else os.environ.get(MAX_EVENTS_ENV, DEFAULT_MAX_EVENTS))
+        except ValueError:
+            self.max_events = DEFAULT_MAX_EVENTS
+        self._dropped = 0
+        self._write_lock = threading.Lock()  # writer thread vs close()
+        self._writer = None
+        self._writer_stop = None
+        # Crash story: mirror every event into an mmap ring in the run
+        # dir — a SIGKILLed server never reaches write(), but the
+        # kernel writes the MAP_SHARED pages back anyway and the
+        # doctor recovers the request tail from the ring alone. The
+        # mirror rides THIS timeline's observer hook (private, never
+        # the global observe.set_flight_recorder — a gang worker's own
+        # ring must stay untouched).
+        self._flight = FlightRecorder(ring_path(self.run_dir, 0))
+        self.timeline.observer = self._flight.record
+        self._req = {}           # rid -> lifecycle state
+
+    # -- frontend hooks (HTTP side) -----------------------------------
+
+    def request_arrived(self, box, prompt_len, max_new, stream):
+        """Stamp the mailbox with the request's wall-clock arrival
+        (its ``t0`` perf stamp already exists) — queue wait and TTFT
+        measure from here, 400s included."""
+        box.obs_wall0 = time.time()
+        box.obs_meta = (int(prompt_len), int(max_new), bool(stream))
+
+    def request_rejected(self, code, reason):
+        """Refused before admission (validation 400, engine-specific
+        submit refusal): no rid, no span tree — one instant + the
+        rejection counter the doctor breaks down by reason."""
+        self.registry.counter(
+            "server_admission_rejections_total", reason=reason).inc()
+        self.timeline.instant("request.reject", cat="serving",
+                              code=int(code), reason=reason)
+
+    def request_submitted(self, rid, box):
+        """The engine thread handed the request to ``engine.submit``
+        — the span tree's root opens here (engine thread only)."""
+        wall0 = getattr(box, "obs_wall0", None) or time.time()
+        meta = getattr(box, "obs_meta", (0, 0, False))
+        self._req[rid] = {
+            "wall0": wall0, "perf0": box.t0,
+            "prompt_len": meta[0], "max_new": meta[1],
+            "stream": meta[2],
+            "admit_wall": None, "admit_perf": None,
+            "first_perf": None, "last_perf": None, "tokens": 0,
+        }
+        self.timeline.instant("request.submit", cat="serving", tid=rid,
+                              rid=rid, prompt_len=meta[0],
+                              max_new=meta[1])
+
+    def token(self, rid):
+        """One generated token reached the frontend: first token
+        observes TTFT, every later one the inter-token gap."""
+        st = self._req.get(rid)
+        if st is None:
+            return
+        now = time.perf_counter()
+        st["tokens"] += 1
+        self.registry.counter("server_generated_tokens_total").inc()
+        if st["first_perf"] is None:
+            st["first_perf"] = now
+            ttft = now - st["perf0"]
+            self.registry.histogram("server_ttft_seconds").observe(ttft)
+            self.timeline.instant("request.first_token", cat="serving",
+                                  tid=rid, rid=rid,
+                                  ttft_s=round(ttft, 6))
+        else:
+            self.registry.histogram(
+                "server_inter_token_seconds"
+            ).observe(now - st["last_perf"])
+        st["last_perf"] = now
+
+    def request_done(self, rid, code=200):
+        """Generation finished (or the request was failed): close the
+        span tree and observe the per-request rate."""
+        st = self._req.pop(rid, None)
+        if st is None:
+            return
+        now_perf = time.perf_counter()
+        total_s = now_perf - st["perf0"]
+        ttft = (st["first_perf"] - st["perf0"]
+                if st["first_perf"] is not None else None)
+        queue_wait = (st["admit_perf"] - st["perf0"]
+                      if st["admit_perf"] is not None else None)
+        # Decode rate over the request's whole residency (admission
+        # included): tokens / (arrival -> done). Failed requests that
+        # never produced a token observe nothing.
+        tps = None
+        if st["tokens"] and total_s > 0:
+            tps = st["tokens"] / total_s
+            self.registry.histogram(
+                "server_tokens_per_sec", buckets=RATE_BUCKETS
+            ).observe(tps)
+        self.timeline.instant(
+            "request.done", cat="serving", tid=rid, rid=rid,
+            code=int(code), tokens=st["tokens"],
+            tokens_per_sec=round(tps, 3) if tps else None,
+        )
+        if queue_wait is not None:
+            self.timeline.complete(
+                "request.queue_wait", st["wall0"], queue_wait,
+                cat="serving", tid=rid, rid=rid,
+            )
+        self.timeline.complete(
+            "request", st["wall0"], total_s, cat="serving", tid=rid,
+            rid=rid, code=int(code), tokens=st["tokens"],
+            ttft_s=round(ttft, 6) if ttft is not None else None,
+            queue_wait_s=(round(queue_wait, 6)
+                          if queue_wait is not None else None),
+            tokens_per_sec=round(tps, 3) if tps else None,
+            stream=st["stream"], prompt_len=st["prompt_len"],
+        )
+
+    # -- engine hooks (models/serving.py, behind `telemetry is not
+    # -- None` on the engine side) ------------------------------------
+
+    def request_admitted(self, rid):
+        """The engine pulled the request off its queue and is starting
+        its prefill — queue wait ends here."""
+        st = self._req.get(rid)
+        if st is None:
+            return
+        st["admit_wall"] = time.time()
+        st["admit_perf"] = time.perf_counter()
+        self.registry.histogram("server_queue_wait_seconds").observe(
+            st["admit_perf"] - st["perf0"])
+        self.timeline.instant("request.admit", cat="serving", tid=rid,
+                              rid=rid)
+
+    def decode_chunk(self, active, n_slots, n_tokens,
+                     free_pages=None, n_pages=None):
+        """Once per decode chunk (or speculation round): the batch
+        shape that explains WHY latency moved."""
+        util = active / max(1, n_slots)
+        self.registry.histogram(
+            "engine_batch_utilization", buckets=UTIL_BUCKETS
+        ).observe(util)
+        self.registry.gauge("engine_active_slots").set(active)
+        self.registry.gauge("engine_slot_occupancy").set(util)
+        self.registry.counter("engine_decode_chunks_total").inc()
+        # tokens DISPATCHED (active slots x chunk steps) vs the
+        # accepted server_generated_tokens_total: the delta is
+        # host-discarded overshoot (mid-chunk eos/budget) — decode
+        # compute the chunk granularity wastes
+        self.registry.counter("engine_decode_tokens_total").inc(
+            active * n_tokens)
+        if n_pages:
+            # page 0 is the reserved junk dump, never allocatable
+            pool = max(1, n_pages - 1)
+            self.registry.gauge("engine_kv_page_occupancy").set(
+                (pool - free_pages) / pool)
+
+    def admission_deferred(self, reason):
+        """Capacity admission control kicked in (request left queued,
+        not refused) — e.g. the paged pool can't cover the queue
+        head's worst case yet."""
+        self.registry.counter(
+            "engine_admission_deferrals_total", reason=reason).inc()
+
+    # -- artifacts -----------------------------------------------------
+
+    def write(self):
+        """Write the run-dir artifacts (same shapes as a training
+        gang's: ``timeline.json`` + ``metrics.prom`` +
+        ``metrics.json``), atomically. Idempotent — a later write
+        re-renders everything retained so far. A serving box runs
+        indefinitely, so the retained trace is BOUNDED: beyond
+        ``max_events`` the oldest events are dropped and counted in
+        the trace's ``dropped_events`` (metrics are cumulative and
+        lose nothing). Returns the paths."""
+        with self._write_lock:
+            self._events.extend(self.timeline.drain())
+            if len(self._events) > self.max_events:
+                drop = len(self._events) - self.max_events
+                del self._events[:drop]
+                self._dropped += drop
+            host = socket.gethostname()
+            trace = chrome_trace(
+                [(0, f"{SERVER_LABEL} @ {host}", self._events)])
+            if self._dropped:
+                trace["dropped_events"] = self._dropped
+            labeled = [({"rank": SERVER_LABEL}, self.registry.snapshot())]
+            files = [
+                ("timeline.json", json.dumps(trace)),
+                ("metrics.prom", render_prometheus(labeled)),
+                ("metrics.json", render_json(labeled, indent=2)),
+            ]
+            paths = {}
+            for name, text in files:
+                path = os.path.join(self.run_dir, name)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(text)
+                os.replace(tmp, path)
+                paths[name] = path
+            self._flight.flush()
+            return paths
+
+    def start_writer(self, interval=None):
+        """Periodic :meth:`write` on a daemon thread: a long-running
+        server keeps its run dir current (the artifacts are readable
+        mid-run, not only after close) and its in-memory event buffer
+        drained. Idempotent; ``interval <= 0`` disables (returns
+        None) — the close-time write still happens."""
+        if self._writer is not None and self._writer.is_alive():
+            return self._writer
+        if interval is None:
+            try:
+                interval = float(
+                    os.environ.get(WRITE_S_ENV, DEFAULT_WRITE_S))
+            except ValueError:
+                interval = DEFAULT_WRITE_S
+        if interval <= 0:
+            return None
+        self._writer_stop = stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval):
+                try:
+                    self.write()
+                except Exception:
+                    pass  # telemetry never takes down the server
+
+        self._writer = threading.Thread(
+            target=loop, name="sparkdl-serving-telemetry-write",
+            daemon=True)
+        self._writer.start()
+        return self._writer
+
+    def stop_writer(self):
+        if self._writer_stop is not None:
+            self._writer_stop.set()
+        if self._writer is not None:
+            self._writer.join(timeout=5.0)
+        self._writer = None
+        self._writer_stop = None
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.stop_writer()
+        self.timeline.observer = None
+        self._flight.close()

@@ -56,12 +56,33 @@ class Timeline:
             except Exception:
                 pass  # the mirror must never break recording
 
-    def instant(self, name, cat="", **args):
-        """Record a point event (``ph: "i"``, process-scoped)."""
+    def instant(self, name, cat="", tid=None, **args):
+        """Record a point event (``ph: "i"``, process-scoped).
+
+        ``tid`` overrides the recording thread's ident — lifecycles
+        that span threads (a serving request crosses an HTTP handler
+        and the engine thread) key their events on a logical id (the
+        request id) so the tree renders as one track per request."""
         ev = {
             "name": name, "cat": cat or "event", "ph": "i",
-            "ts": int(self._clock() * 1e6), "s": "p", "tid": _tid(),
+            "ts": int(self._clock() * 1e6), "s": "p",
+            "tid": _tid() if tid is None else int(tid),
             "args": args,
+        }
+        with self._lock:
+            self._events.append(ev)
+        self._mirror(ev)
+        return ev
+
+    def complete(self, name, start, dur, cat="", tid=None, **args):
+        """Record a complete event (``ph: "X"``) with an EXPLICIT
+        wall-clock ``start`` and ``dur`` (both seconds) — for spans
+        whose endpoints were measured on different threads, where the
+        :meth:`span` context manager cannot wrap the block."""
+        ev = {
+            "name": name, "cat": cat or "span", "ph": "X",
+            "ts": int(start * 1e6), "dur": max(0, int(dur * 1e6)),
+            "tid": _tid() if tid is None else int(tid), "args": args,
         }
         with self._lock:
             self._events.append(ev)

@@ -1,0 +1,278 @@
+"""Request-level serving observability (ISSUE 6 tentpole): the
+per-request span tree, the SLO histograms on ``GET /metrics``, the
+run-dir artifacts, and the zero-overhead latch — pinned on a fake
+engine (no model) plus one real-engine integration proof."""
+
+import glob
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.models.server import ServingFrontend
+
+
+class _FakeCfg:
+    max_cache_len = 64
+
+
+class _ObservedFakeEngine:
+    """Engine-shaped stub that drives the telemetry hooks the way the
+    real engines do: admit on queue pop, one decode_chunk per run,
+    tokens through on_token — so the span tree and histograms are
+    pinned without paying for a model."""
+
+    def __init__(self, fault=None):
+        self.cfg = _FakeCfg()
+        self.fault = fault
+        self.finish_reasons = {}
+        self.logprobs = {}
+        self._queued = {}
+        self._next = 0
+        self.telemetry = None   # the frontend installs it when opted in
+
+    def _worst_case_tokens(self, prompt_len, max_new):
+        return prompt_len + max_new
+
+    def submit(self, tokens, max_new_tokens, stop=None):
+        rid = self._next
+        self._next += 1
+        self._queued[rid] = max_new_tokens
+        return rid
+
+    def run(self, progress=None, on_token=None):
+        if self.fault is not None:
+            fault, self.fault = self.fault, None
+            raise fault
+        out = {}
+        for rid, n in self._queued.items():
+            if self.telemetry is not None:
+                self.telemetry.request_admitted(rid)
+            toks = np.arange(n, dtype=np.int32)
+            if on_token is not None:
+                for t in toks:
+                    on_token(rid, t)
+            out[rid] = toks
+            self.finish_reasons[rid] = "length"
+            self.logprobs[rid] = [0.0] * n
+        if self.telemetry is not None:
+            self.telemetry.decode_chunk(len(out), 4, 1)
+        self._queued.clear()
+        return out
+
+    def abort_requests(self):
+        self._queued.clear()
+
+
+def _post(fe, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://{fe.address[0]}:{fe.address[1]}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _metrics(fe):
+    with urllib.request.urlopen(
+            f"http://{fe.address[0]}:{fe.address[1]}/metrics",
+            timeout=60) as r:
+        return r.read().decode()
+
+
+@pytest.fixture
+def telemetry_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    yield str(tmp_path)
+    observe._reset_for_tests()
+
+
+def _serving_events(run_dir):
+    """{rid: {event name: ts}} plus the rid-less events, from the run
+    dir's merged trace."""
+    runs = glob.glob(os.path.join(run_dir, "run-*"))
+    assert len(runs) == 1, runs
+    with open(os.path.join(runs[0], "timeline.json")) as f:
+        trace = json.load(f)
+    by_rid, loose = {}, []
+    for ev in trace["traceEvents"]:
+        if ev.get("cat") != "serving":
+            continue
+        rid = ev.get("args", {}).get("rid")
+        if rid is None:
+            loose.append(ev)
+        else:
+            by_rid.setdefault(rid, {})[ev["name"]] = ev
+    return by_rid, loose, runs[0]
+
+
+def test_span_tree_and_slo_histograms(telemetry_dir):
+    """Streamed, non-streamed, 400-class, and engine-fault requests:
+    the SLO histograms populate and every traced request's instants
+    are well-ordered (submit <= admit <= first_token <= done)."""
+    fe = ServingFrontend(_ObservedFakeEngine(
+        fault=RuntimeError("engine exploded"))).start()
+    try:
+        assert fe.request_telemetry is not None
+        assert fe.engine.telemetry is fe.request_telemetry
+        # engine fault first (the fake raises once): its waiter is a
+        # traced request that dies with code 500
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(fe, {"tokens": [1, 2], "max_new_tokens": 4})
+        assert e.value.code == 500
+        # 400-class: rejected before any rid exists
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(fe, {"tokens": [1, 2], "max_new_tokens": 1000})
+        assert e.value.code == 400
+        # non-streamed success
+        with _post(fe, {"tokens": [1, 2], "max_new_tokens": 3}) as r:
+            assert json.loads(r.read())["tokens"] == [0, 1, 2]
+        # streamed success
+        with _post(fe, {"tokens": [5], "max_new_tokens": 4,
+                        "stream": True}) as r:
+            assert b'"done"' in r.read()
+
+        body = _metrics(fe)
+        # SLO histograms (served requests: 2) + per-token series
+        assert "server_ttft_seconds_count 2" in body
+        assert "server_queue_wait_seconds_count 2" in body
+        assert "server_tokens_per_sec_count 2" in body
+        # 3 + 4 tokens over two requests -> 5 inter-token gaps
+        assert "server_inter_token_seconds_count 5" in body
+        assert "server_generated_tokens_total 7" in body
+        assert ('server_admission_rejections_total'
+                '{reason="invalid_request"} 1') in body
+        # engine-side gauges rode the fake's hooks
+        assert "engine_batch_utilization_count" in body
+        assert "engine_decode_chunks_total" in body
+    finally:
+        fe.close()
+
+    by_rid, loose, run_dir = _serving_events(telemetry_dir)
+    # rid 0 = the faulted request: submitted, then failed with 500 —
+    # never admitted, never produced a token
+    fault = by_rid[0]
+    assert fault["request.done"]["args"]["code"] == 500
+    assert "request.first_token" not in fault
+    assert (fault["request.submit"]["ts"]
+            <= fault["request.done"]["ts"])
+    # the two served requests: full, well-ordered span trees
+    for rid in (1, 2):
+        tree = by_rid[rid]
+        assert (tree["request.submit"]["ts"]
+                <= tree["request.admit"]["ts"]
+                <= tree["request.first_token"]["ts"]
+                <= tree["request.done"]["ts"]), tree
+        root = tree["request"]
+        assert root["ph"] == "X"
+        assert root["args"]["code"] == 200
+        assert root["args"]["ttft_s"] is not None
+        assert root["args"]["tokens_per_sec"] is not None
+        assert tree["request.queue_wait"]["ph"] == "X"
+        # the tree is request-id-keyed: one track per request
+        assert {e["tid"] for e in tree.values()} == {rid}
+    # the 400 never got a rid: one reject instant carries it
+    rejects = [e for e in loose if e["name"] == "request.reject"]
+    assert len(rejects) == 1
+    assert rejects[0]["args"]["code"] == 400
+    # metrics artifacts landed next to the trace, rank-labeled like a
+    # gang run's
+    with open(os.path.join(run_dir, "metrics.prom")) as f:
+        prom = f.read()
+    assert 'server_ttft_seconds_count{rank="server"} 2' in prom
+    assert os.path.exists(os.path.join(run_dir, "metrics.json"))
+    # crash-story ring was mirrored alongside
+    assert glob.glob(os.path.join(run_dir, "flightrec-rank-*.ring"))
+
+
+def test_zero_overhead_latch_on_serving_path(monkeypatch):
+    """No SPARKDL_TPU_TELEMETRY_DIR -> the serving hot path performs
+    ZERO observe work per token: no ServingTelemetry, no engine hook,
+    no timeline events, no SLO series on /metrics (the PR-3 latch,
+    extended to serving the way PR 5 pinned heartbeat threads)."""
+    monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+    observe._reset_for_tests()
+    try:
+        eng = _ObservedFakeEngine()
+        fe = ServingFrontend(eng).start()
+        try:
+            assert fe.request_telemetry is None
+            assert eng.telemetry is None      # engine hook stays dark
+            with _post(fe, {"tokens": [1], "max_new_tokens": 4,
+                            "stream": True}) as r:
+                assert b'"done"' in r.read()
+            with _post(fe, {"tokens": [1, 2],
+                            "max_new_tokens": 2}) as r:
+                r.read()
+            body = _metrics(fe)
+            # the always-on API metrics still serve...
+            assert 'server_requests_total{code="200"} 2' in body
+            # ...but none of the latch-gated SLO series exist
+            for name in ("server_ttft_seconds",
+                         "server_inter_token_seconds",
+                         "server_queue_wait_seconds",
+                         "server_tokens_per_sec",
+                         "server_generated_tokens_total",
+                         "engine_batch_utilization"):
+                assert name not in body
+        finally:
+            fe.close()
+        # nothing leaked into the process-global timeline or registry
+        assert len(observe.timeline()) == 0
+        assert observe.metrics().snapshot()["counters"] == []
+    finally:
+        observe._reset_for_tests()
+
+
+@pytest.mark.slow
+def test_real_engine_telemetry_integration(telemetry_dir):
+    """One real ContinuousBatchingEngine behind the frontend: the
+    engine-internal hooks (chunk utilization, paged-pool occupancy)
+    and per-request spans come from the actual decode loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import Llama, LlamaConfig
+    from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, max_cache_len=96)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4,
+                                   page_size=16)
+    fe = ServingFrontend(eng).start()
+    try:
+        results = [None, None]
+
+        def client(i, n):
+            with _post(fe, {"tokens": [1 + i, 2, 3],
+                            "max_new_tokens": n},
+                       timeout=300) as r:
+                results[i] = json.loads(r.read())
+
+        threads = [threading.Thread(target=client, args=(i, 6 + i))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(r is not None for r in results)
+        body = _metrics(fe)
+        assert "server_ttft_seconds_count 2" in body
+        assert "engine_batch_utilization_count" in body
+        assert "engine_kv_page_occupancy" in body
+    finally:
+        fe.close()
+    by_rid, _loose, _run = _serving_events(telemetry_dir)
+    for rid, tree in by_rid.items():
+        assert (tree["request.submit"]["ts"]
+                <= tree["request.admit"]["ts"]
+                <= tree["request.first_token"]["ts"]
+                <= tree["request.done"]["ts"]), (rid, tree)

@@ -1,0 +1,228 @@
+"""observe.doctor over SERVING run dirs (ISSUE 6 satellite): slowest-
+requests table by TTFT, admission-rejection breakdown, batch-
+utilization summary — and the crash path where the doctor reproduces
+the story from the flight-recorder ring alone."""
+
+import json
+import os
+import time
+import types
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe import doctor
+from sparkdl_tpu.observe.metrics import Registry
+from sparkdl_tpu.observe.serving import ServingTelemetry
+
+
+@pytest.fixture
+def serving_run(tmp_path):
+    """A run dir written by a real ServingTelemetry driven through a
+    scripted request mix: one fast request, one slow one, a 400
+    rejection, a paged-pool deferral, and three decode chunks."""
+    observe._reset_for_tests()
+    run_dir = str(tmp_path / "run-777-0")
+    os.makedirs(run_dir)
+    reg = Registry()
+    rt = ServingTelemetry(reg, run_dir=run_dir)
+    try:
+        # rid 0: fast (one tiny sleep before the first token)
+        box0 = types.SimpleNamespace(t0=time.perf_counter())
+        rt.request_arrived(box0, 4, 8, False)
+        rt.request_submitted(0, box0)
+        rt.request_admitted(0)
+        time.sleep(0.002)
+        for _ in range(3):
+            rt.token(0)
+        rt.request_done(0, code=200)
+        # rid 1: slow TTFT — must top the slowest table
+        box1 = types.SimpleNamespace(t0=time.perf_counter())
+        rt.request_arrived(box1, 16, 8, True)
+        rt.request_submitted(1, box1)
+        rt.request_admitted(1)
+        time.sleep(0.05)
+        for _ in range(4):
+            rt.token(1)
+        rt.request_done(1, code=200)
+        reg.counter("server_requests_total", code="200").inc(2)
+        reg.counter("server_requests_total", code="400").inc()
+        rt.request_rejected(400, "invalid_request")
+        rt.admission_deferred("pool_exhausted")
+        for active in (1, 2, 2):
+            rt.decode_chunk(active, 4, 8, free_pages=5, n_pages=9)
+        rt.write()
+    finally:
+        rt.close()
+        observe._reset_for_tests()
+    return run_dir
+
+
+def test_serving_section(serving_run):
+    diag = doctor.diagnose(serving_run)
+    assert diag is not None and not diag["hang"]
+    srv = diag["serving"]
+    assert srv["requests"] == 2
+    assert srv["by_code"] == {"200": 2, "400": 1}
+    slowest = srv["slowest_requests_by_ttft"]
+    assert [r["rid"] for r in slowest] == [1, 0]   # slow one first
+    assert slowest[0]["ttft_s"] >= 0.05
+    assert slowest[0]["tokens"] == 4
+    assert srv["admission_rejections"] == {
+        "invalid_request": 1,
+        "pool_exhausted (deferred, requeued)": 1,
+    }
+    util = srv["batch_utilization"]
+    assert util["chunks"] == 3
+    assert abs(util["mean"] - (1 + 2 + 2) / (3 * 4)) < 1e-4
+    # a serving run with no hang exits 0; text render names the table
+    text = doctor.render_text(diag)
+    assert "serving: 2 traced request(s)" in text
+    assert "slowest requests by TTFT" in text
+    assert "batch utilization: 0.42 mean over 3 decode chunk(s)" in text
+
+
+def test_serving_json_format_and_exit_code(serving_run, capsys):
+    rc = doctor.main([serving_run, "--format", "json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["serving"]["requests"] == 2
+    assert out["serving"]["batch_utilization"]["chunks"] == 3
+
+
+def test_clean_run_needs_no_ring_recovery(serving_run):
+    """A cleanly written run dir: every ring event is already in
+    timeline.json, so nothing is 'recovered'."""
+    diag = doctor.diagnose(serving_run)
+    assert diag["recovered_from_flight_recorder"] is False
+    assert diag["flight_recorder_recovered_events"] == 0
+
+
+def test_crashed_server_recovered_from_ring(serving_run):
+    """SIGKILL story: the server died before close() ever wrote
+    timeline.json — the doctor rebuilds the request tail from the
+    mmap ring the flight recorder left behind."""
+    for name in ("timeline.json", "metrics.json", "metrics.prom"):
+        os.remove(os.path.join(serving_run, name))
+    diag = doctor.diagnose(serving_run)
+    assert diag is not None
+    assert diag["recovered_from_flight_recorder"] is True
+    assert diag["flight_recorder_recovered_events"] > 0
+    srv = diag["serving"]
+    assert srv["requests"] == 2
+    assert [r["rid"] for r in srv["slowest_requests_by_ttft"]] == [1, 0]
+    assert "flight-recorder ring" in doctor.render_text(diag)
+
+
+def test_kill_between_writes_merges_ring_tail(tmp_path):
+    """The REAL long-running-server kill: a periodic write landed at
+    t, the kill at t+dt — timeline.json is stale, the ring holds the
+    newer requests. The doctor must merge the tail, not prefer the
+    stale file."""
+    observe._reset_for_tests()
+    run_dir = str(tmp_path / "run-11-0")
+    os.makedirs(run_dir)
+    rt = ServingTelemetry(Registry(), run_dir=run_dir)
+    try:
+        def one_request(rid):
+            box = types.SimpleNamespace(t0=time.perf_counter())
+            rt.request_arrived(box, 2, 4, False)
+            rt.request_submitted(rid, box)
+            rt.request_admitted(rid)
+            rt.token(rid)
+            rt.request_done(rid, code=200)
+
+        one_request(0)
+        rt.write()              # the periodic writer's last write
+        one_request(1)          # ...then the kill: never written
+        rt._flight.flush()
+    finally:
+        rt.close()              # close() does NOT write artifacts
+        observe._reset_for_tests()
+    diag = doctor.diagnose(run_dir)
+    assert diag["recovered_from_flight_recorder"] is True
+    # exactly request 1's events were cut off (6 per request)
+    assert diag["flight_recorder_recovered_events"] == 6
+    srv = diag["serving"]
+    assert srv["requests"] == 2
+    assert {r["rid"] for r in srv["slowest_requests_by_ttft"]} == {0, 1}
+
+
+def test_trace_retention_is_bounded(tmp_path):
+    """A serving box runs indefinitely: the retained trace keeps only
+    the newest ``max_events`` (dropped count surfaced in the trace),
+    while the cumulative metrics lose nothing."""
+    observe._reset_for_tests()
+    run_dir = str(tmp_path / "run-9-0")
+    os.makedirs(run_dir)
+    reg = Registry()
+    rt = ServingTelemetry(reg, run_dir=run_dir, max_events=10)
+    try:
+        for rid in range(8):
+            box = types.SimpleNamespace(t0=time.perf_counter())
+            rt.request_arrived(box, 2, 4, False)
+            rt.request_submitted(rid, box)
+            rt.request_admitted(rid)
+            rt.token(rid)
+            rt.request_done(rid, code=200)
+            rt.write()
+        paths = rt.write()
+    finally:
+        rt.close()
+        observe._reset_for_tests()
+    with open(paths["timeline.json"]) as f:
+        trace = json.load(f)
+    events = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert len(events) == 10
+    assert trace["dropped_events"] == 8 * 6 - 10
+    # newest events survived: the last request's full tree is there
+    assert {e["args"].get("rid") for e in events} <= {6, 7}
+    # metrics are cumulative — nothing dropped
+    with open(paths["metrics.prom"]) as f:
+        prom = f.read()
+    assert 'server_ttft_seconds_count{rank="server"} 8' in prom
+
+
+def test_periodic_writer_keeps_run_dir_current(tmp_path):
+    observe._reset_for_tests()
+    run_dir = str(tmp_path / "run-10-0")
+    os.makedirs(run_dir)
+    rt = ServingTelemetry(Registry(), run_dir=run_dir)
+    try:
+        assert rt.start_writer(interval=0.05) is not None
+        assert rt.start_writer(interval=0.05) is rt._writer  # idempotent
+        box = types.SimpleNamespace(t0=time.perf_counter())
+        rt.request_arrived(box, 2, 4, False)
+        rt.request_submitted(0, box)
+        rt.request_admitted(0)
+        rt.token(0)
+        rt.request_done(0, code=200)
+        deadline = time.monotonic() + 5
+        tl = os.path.join(run_dir, "timeline.json")
+        while time.monotonic() < deadline:
+            if os.path.exists(tl) and "request" in open(tl).read():
+                break
+            time.sleep(0.02)
+        # written MID-RUN, before any close()
+        assert os.path.exists(tl)
+        assert "request" in open(tl).read()
+    finally:
+        rt.close()
+        observe._reset_for_tests()
+    assert rt._writer is None   # close() stopped the writer
+
+
+def test_gang_run_dirs_unchanged(tmp_path):
+    """A pure training-gang dir gets no serving section (and the
+    doctor's gang behavior is untouched)."""
+    run_dir = str(tmp_path / "run-1-0")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "timeline.json"), "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "train_step", "ph": "X", "ts": 1, "dur": 5,
+             "tid": 1, "cat": "train", "args": {}},
+        ]}, f)
+    diag = doctor.diagnose(run_dir)
+    assert diag is not None
+    assert diag["serving"] is None
+    assert "serving:" not in doctor.render_text(diag)

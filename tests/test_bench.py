@@ -74,6 +74,62 @@ def test_bench_second_run_warm_starts(tmp_path):
     assert warm["last_loss"] == cold["last_loss"]  # same executable
 
 
+@pytest.mark.gang
+@pytest.mark.slow   # a full proxy measurement (~1 min) — outside tier-1
+def test_bench_cpu_proxy_on_deviceless_host():
+    """ROADMAP item 4 ("un-null the perf trajectory"): a cpu-only run
+    WITHOUT the tiny smoke flag measures the fixed-shape CPU proxy and
+    reports vs_baseline against the committed CPU baseline — every
+    future PR lands a real number on this deviceless container."""
+    r = _run({"SPARKDL_TPU_BENCH_PLATFORM": "cpu"}, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "llama_lora_train_tokens_per_sec_cpu_proxy"
+    assert out["value"] > 0
+    assert out["unit"] == "tokens/sec (cpu proxy)"
+    # vs_baseline is computed against the COMMITTED cpu-proxy baseline
+    # (BASELINE.json:published), not defaulted to 1.0
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        base = json.load(f)["published"][
+            "llama_lora_train_tokens_per_sec_cpu_proxy"]
+    assert out["vs_baseline"] == pytest.approx(out["value"] / base,
+                                               abs=0.002)
+    assert out["platform"] == "cpu"
+    assert out["steps_per_sec_p50"] > 0
+    # MFU is chip-relative — meaningless for the proxy, so absent
+    assert "mfu" not in out
+
+
+@pytest.mark.skipif(
+    bool(__import__("glob").glob("/dev/accel*")
+         + __import__("glob").glob("/dev/vfio/*")
+         + __import__("glob").glob("/dev/nvidia*")),
+    reason="host has accelerator devices; probe retries are legitimate")
+def test_bench_probe_fast_fails_without_accel_devices():
+    """No /dev/accel* -> ONE probe attempt, no retry schedule (the
+    multi-minute pause ladder exists for wedged leases, not absent
+    chips). The explicit bogus platform pins the probe failure AND
+    opts out of the cpu-proxy fallback, so the bench must report the
+    error quickly. Deliberately does NOT set the PROBE_PAUSE compat
+    var: with retries the default schedule would burn ~6.5 minutes."""
+    import time
+
+    t0 = time.monotonic()
+    r = _run({
+        "SPARKDL_TPU_BENCH_PLATFORM": "nosuchplatform",
+        "SPARKDL_TPU_BENCH_PROBE_TIMEOUT": "90",
+    }, timeout=200)
+    elapsed = time.monotonic() - t0
+    assert r.returncode != 0
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] is None
+    assert "unavailable" in out["error"]
+    assert elapsed < 150, f"probe retried despite no /dev/accel* " \
+                          f"({elapsed:.0f}s)"
+
+
 def _load_bench():
     import importlib.util
 
