@@ -92,9 +92,10 @@ UNIT = "tokens/sec/chip"
 METRIC_CPU = "llama_lora_train_tokens_per_sec_cpu_proxy"
 UNIT_CPU = "tokens/sec (cpu proxy)"
 
-# Peak bf16 FLOPs/s for the chip MFU is computed against (v5e ≈ 197
-# TFLOPs; override for other chips).
-PEAK_FLOPS = float(os.environ.get("SPARKDL_TPU_PEAK_FLOPS", 197e12))
+# Peak FLOPs for MFU live in ONE place now — the per-device-kind
+# table in sparkdl_tpu.observe.perf (SPARKDL_TPU_PEAK_FLOPS still
+# overrides) — and the denominator is keyed off the PROBED device
+# kind instead of assuming v5e.
 
 
 def _fail(msg, rc=2, allow_stale=False, attach_cache=False):
@@ -510,7 +511,11 @@ def run():
     attn = 3 * (4 * seq * cfg.d_model) / 2 * cfg.n_layers
     flops_per_token = 4 * n_matmul + 2 * n_train + attn
     model_flops_per_sec = flops_per_token * tokens_per_sec
-    mfu = model_flops_per_sec / PEAK_FLOPS
+
+    from sparkdl_tpu.observe import perf
+
+    device_kind = perf.device_kind()
+    mfu = model_flops_per_sec / perf.peak_flops(device_kind)
 
     base = _baseline_value(METRIC_CPU if cpu_proxy else METRIC)
     rec = {
@@ -526,6 +531,11 @@ def run():
         "steps_per_sec_p50": round(steps_per_sec_p50, 3),
         "steps_per_sec_p99": round(steps_per_sec_p99, 3),
         "hbm_high_water_bytes": hbm_high_water,
+        "device_kind": device_kind,
+        # who measured this: observe.compare treats records from a
+        # different host fingerprint as advisory, not enforceable
+        "host": perf.host_fingerprint(),
+        "rate_samples": [round(r * batch * seq, 1) for r in rates],
         **({"promoted": promoted} if promoted else {}),
     }
     if not cpu_proxy:
@@ -534,6 +544,23 @@ def run():
         # utilization.
         rec["mfu"] = round(mfu, 4)
         rec["model_tflops_per_sec"] = round(model_flops_per_sec / 1e12, 1)
+    # Regression ledger (observe.perf): one schema-versioned line per
+    # measured run in benchmarks/results/history.jsonl — the file
+    # `python -m sparkdl_tpu.observe.compare` diffs and the CI perf
+    # gate enforces. Best-effort: the ledger never fails the bench.
+    perf.append_history(perf.history_record(
+        {rec["metric"]: {
+            "value": rec["value"], "unit": rec["unit"],
+            "samples": rec["rate_samples"],
+            # p50/p99 in the metric's own unit (tokens/sec), not the
+            # steps/sec the JSON record reports alongside
+            "p50": round(steps_per_sec_p50 * batch * seq, 1),
+            "p99": round(steps_per_sec_p99 * batch * seq, 1),
+        }},
+        device_kind=device_kind, bench="bench.py",
+        extra={"warm_start": warm_start,
+               "compile_seconds": rec["compile_seconds"]},
+    ))
     print(json.dumps(rec))
 
 

@@ -26,7 +26,11 @@ import functools
 
 import numpy as np
 
-PEAK_FLOPS = float(os.environ.get("SPARKDL_TPU_PEAK_FLOPS", 197e12))
+# MFU denominators come from the ONE per-device-kind peak table
+# (sparkdl_tpu.observe.perf; SPARKDL_TPU_PEAK_FLOPS still overrides),
+# keyed off the probed device kind (perf.device_kind) instead of a
+# hard-coded v5e copy.
+from sparkdl_tpu.observe import perf as _perf
 
 
 def _measure_scan(step, carry, batch_data, n_steps):
@@ -93,12 +97,14 @@ def bench_resnet50(batch=128, image=224, n_steps=10):
     sps = n_steps * batch / dt
     # ResNet-50 @224: ~4.09 GFLOP forward/sample; x3 for fwd+bwd.
     model_flops = 3 * 4.09e9 * sps
+    kind = _perf.device_kind()
     return {
         "metric": "resnet50_train_samples_per_sec_per_chip",
         "value": round(sps, 1), "unit": "samples/sec/chip",
         "batch": batch, "image": image,
+        "device_kind": kind,
         "model_tflops_per_sec": round(model_flops / 1e12, 1),
-        "mfu": round(model_flops / PEAK_FLOPS, 4),
+        "mfu": round(model_flops / _perf.peak_flops(kind), 4),
         "last_loss": round(last, 4),
     }
 
@@ -152,12 +158,14 @@ def bench_bert_squad(batch=32, seq=384, n_steps=10):
     attn = cfg.n_layers * 4 * seq * cfg.d_model
     flops_per_token = 3 * (2 * n_matmul + attn)
     model_flops = flops_per_token * sps * seq
+    kind = _perf.device_kind()
     return {
         "metric": "bert_base_squad_train_samples_per_sec_per_chip",
         "value": round(sps, 1), "unit": "samples/sec/chip",
         "batch": batch, "seq": seq,
+        "device_kind": kind,
         "model_tflops_per_sec": round(model_flops / 1e12, 1),
-        "mfu": round(model_flops / PEAK_FLOPS, 4),
+        "mfu": round(model_flops / _perf.peak_flops(kind), 4),
         "last_loss": round(last, 4),
     }
 
@@ -180,7 +188,15 @@ def main():
         jobs = [bench_resnet50, bench_bert_squad]
     for job in jobs:
         try:
-            print(json.dumps(job()), flush=True)
+            rec = job()
+            _perf.append_history(_perf.history_record(
+                {rec["metric"]: {"value": rec["value"],
+                                 "unit": rec["unit"]}},
+                device_kind=rec.get("device_kind"),
+                bench="model_bench.py",
+                extra={"mfu": rec.get("mfu")},
+            ))
+            print(json.dumps(rec), flush=True)
         except Exception as e:  # keep sweeping on OOM etc.
             print(json.dumps({"error": str(e)[:300]}), flush=True)
 

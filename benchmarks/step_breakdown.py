@@ -27,7 +27,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-PEAK_FLOPS = float(os.environ.get("SPARKDL_TPU_PEAK_FLOPS", 197e12))
+# Peak constants live in the ONE per-device-kind table
+# (sparkdl_tpu.observe.perf); this file's old v5e copy is gone. The
+# breakdown document below uses perf.make_breakdown so the hand-rolled
+# decomposition and the telemetry-derived attribution share one schema
+# (cross-checkable in one file format), and every run appends to the
+# same history.jsonl ledger the compare gate reads.
+from sparkdl_tpu.observe import perf as _perf
 
 
 def _timed(jit_fn, *args, n_steps):
@@ -195,11 +201,25 @@ def main():
         trace_note = f"trace capture failed: {e}"
 
     tok_s = batch * seq / t_step
+    device_kind = _perf.device_kind()
+    # The same breakdown-document schema observe.perf derives from the
+    # timeline — component axis differs (forward/backward/optimizer vs
+    # compute/collective/...), the shape and sum-to-total contract are
+    # identical, so both land in one file format and one ledger.
+    breakdown = _perf.make_breakdown(
+        t_step,
+        {"forward": t_fwd,
+         "backward": t_grad - t_fwd,
+         "optimizer": t_step - t_grad},
+        source="measured",
+    )
     out = {
         "metric": "headline_step_breakdown",
         "platform": jax.devices()[0].platform,
+        "device_kind": device_kind,
         "batch": batch, "seq": seq,
         "tokens_per_sec": round(tok_s, 1),
+        "breakdown": breakdown,
         "ms": {
             "step": round(t_step * 1e3, 3),
             "forward": round(t_fwd * 1e3, 3),
@@ -213,6 +233,14 @@ def main():
         },
         "trace": trace_note,
     }
+    _perf.append_history(_perf.history_record(
+        {"headline_step_tokens_per_sec": {
+            "value": round(tok_s, 1), "unit": "tokens/sec"},
+         "headline_step_seconds": {
+            "value": t_step, "unit": "s", "higher_is_better": False}},
+        device_kind=device_kind, bench="step_breakdown.py",
+        extra={"breakdown": breakdown},
+    ))
     print(json.dumps(out), flush=True)
 
 

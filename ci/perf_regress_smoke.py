@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""CI perf-regression gate smoke (ISSUE 7): prove the
+``observe.compare`` gate fires in BOTH directions before trusting it
+with real regressions.
+
+1. Run the cpu-proxy bench twice (run 2 is a warm start — the
+   persistent compile cache makes the pair cheap).
+2. ``compare run1 run2`` must exit 0: two runs of the same code on the
+   same machine are not a regression (the noise-aware IQR threshold
+   over the per-rep samples absorbs timer jitter).
+3. ``compare BASELINE.json run2`` must exit 0: the committed baseline
+   enforces on the machine whose ``host_fingerprint`` it carries and
+   degrades to advisory on any other host (a GitHub runner's cpu-proxy
+   number is apples-to-oranges against the dev container's) — either
+   way, a green build.
+4. ``compare run2 degraded`` — a synthetically slowed copy (×0.5 —
+   a 50% cliff; uniform scaling preserves the samples' rel-IQR, so
+   the factor must sit safely above any plausible noise threshold a
+   contended runner produces) — **must exit non-zero**, or the gate
+   is decorative and the build fails loudly.
+
+Every bench JSON, the appended history ledger, and the compare
+reports land in the artifacts dir the workflow uploads.
+
+Usage: ``python ci/perf_regress_smoke.py [artifacts_dir]`` (default
+``./perf-regress-artifacts``). Runs outside the time-boxed tier-1
+pytest gate — its own workflow step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_TIMEOUT_S = 900
+
+
+def fail(msg):
+    print(f"PERF REGRESS SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_bench(env, out_path):
+    """One full ``python bench.py`` orchestration (probe fast-fail →
+    cpu proxy on deviceless hosts); the last stdout line is the
+    record."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        env=env, capture_output=True, text=True,
+        timeout=BENCH_TIMEOUT_S,
+    )
+    if proc.returncode != 0:
+        fail(f"bench exited {proc.returncode}:\n{proc.stderr[-2000:]}")
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    if not lines:
+        fail("bench produced no output")
+    try:
+        rec = json.loads(lines[-1])
+    except ValueError as e:
+        fail(f"bench output is not JSON ({e}): {lines[-1][:200]}")
+    if not isinstance(rec.get("value"), (int, float)):
+        fail(f"bench record has no numeric value: {rec}")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    print(f"bench: {rec['metric']} = {rec['value']} {rec.get('unit')}"
+          f" -> {out_path}")
+    return rec
+
+
+def compare(base, cand, report_path, extra_args=()):
+    """Run the REAL gate — the CLI module, exit code and all."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.compare",
+         base, cand, "--format", "json", *extra_args],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    with open(report_path, "w") as f:
+        f.write(proc.stdout or proc.stderr)
+    print(f"compare {os.path.basename(base)} -> "
+          f"{os.path.basename(cand)}: rc={proc.returncode}"
+          f" (report: {report_path})")
+    return proc.returncode
+
+
+def main():
+    art = os.path.abspath(
+        sys.argv[1] if len(sys.argv) > 1 else "perf-regress-artifacts")
+    os.makedirs(art, exist_ok=True)
+
+    env = dict(os.environ)
+    # the CI ledger lands in the artifacts dir, not the repo copy
+    env["SPARKDL_TPU_PERF_HISTORY"] = os.path.join(art, "history.jsonl")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    run1 = os.path.join(art, "bench-run1.json")
+    run2 = os.path.join(art, "bench-run2.json")
+    rec1 = run_bench(env, run1)
+    run_bench(env, run2)
+
+    # direction 1: same code, same machine -> green
+    rc = compare(run1, run2, os.path.join(art, "compare-run1-run2.json"))
+    if rc != 0:
+        fail(f"two runs of the same bench compared rc={rc}; "
+             "the gate would block every PR")
+
+    # committed baseline: enforced on its own host, advisory elsewhere
+    baseline = os.path.join(ROOT, "BASELINE.json")
+    rc = compare(baseline, run2,
+                 os.path.join(art, "compare-baseline.json"))
+    if rc != 0:
+        fail(f"candidate regresses the committed baseline (rc={rc}); "
+             "see compare-baseline.json")
+
+    # direction 2: an injected 50% cliff MUST trip the gate (x0.5
+    # keeps the rel-IQR identical, so the factor is chosen to clear
+    # any noise threshold a contended runner can legitimately widen
+    # the gate to)
+    with open(run2) as f:
+        degraded = json.load(f)
+    degraded["value"] = round(degraded["value"] * 0.5, 1)
+    for k in ("steps_per_sec_p50", "steps_per_sec_p99"):
+        if isinstance(degraded.get(k), (int, float)):
+            degraded[k] = round(degraded[k] * 0.5, 3)
+    if isinstance(degraded.get("rate_samples"), list):
+        degraded["rate_samples"] = [
+            round(s * 0.5, 1) for s in degraded["rate_samples"]]
+    degraded_path = os.path.join(art, "bench-degraded.json")
+    with open(degraded_path, "w") as f:
+        json.dump(degraded, f, indent=2)
+    rc = compare(run2, degraded_path,
+                 os.path.join(art, "compare-degraded.json"))
+    if rc == 0:
+        fail("a synthetic 50% slowdown passed the gate; "
+             "the regression check is decorative")
+
+    # the ledger got one line per run
+    try:
+        with open(env["SPARKDL_TPU_PERF_HISTORY"]) as f:
+            entries = [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, ValueError) as e:
+        fail(f"history ledger missing or malformed: {e}")
+    if len(entries) < 2:
+        fail(f"expected >=2 history entries, found {len(entries)}")
+    if entries[-1]["metrics"].get(rec1["metric"]) is None:
+        fail(f"ledger entry missing metric {rec1['metric']!r}")
+
+    print(f"perf regress smoke OK: artifacts under {art}")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,11 +39,14 @@ from sparkdl_tpu.utils.jax_compat import (
 
 
 def _observed(op_name):
-    """Per-collective telemetry: op count, payload bytes, and a
-    wall-time histogram under ``op=<name>`` labels (the engine-level
-    view an allreduce slowdown shows up in first). The hot path pays
-    one cached-boolean check when telemetry is off — the decorator
-    never touches the argument otherwise."""
+    """Per-collective telemetry: op count, payload bytes, a wall-time
+    histogram under ``op=<name>`` labels (the engine-level view an
+    allreduce slowdown shows up in first), and a ``cat="collective"``
+    timeline span — the raw material ``observe.perf`` attributes step
+    time from (a span on the step's own thread is serialized collective
+    time; one on another thread is overlapped with compute). The hot
+    path pays one cached-boolean check when telemetry is off — the
+    decorator never touches the argument otherwise."""
 
     def deco(fn):
         @functools.wraps(fn)
@@ -58,16 +61,18 @@ def _observed(op_name):
             # counter; the EXIT bumps it again so a rank merely
             # looping fast on tiny collectives still reads as live.
             health.note_collective(op_name)
+            nbytes = int(getattr(x, "nbytes", 0) or 0)
+            wall0 = time.time()
             t0 = time.perf_counter()
             out = fn(self, x, *args, **kwargs)
             dt = time.perf_counter() - t0
             health.note_collective(op_name, done=True)
             observe.inc("collective_ops_total", op=op_name)
-            observe.inc(
-                "collective_bytes_total",
-                value=int(getattr(x, "nbytes", 0) or 0), op=op_name,
-            )
+            observe.inc("collective_bytes_total", value=nbytes,
+                        op=op_name)
             observe.observe_value("collective_seconds", dt, op=op_name)
+            observe.complete(op_name, wall0, dt, cat="collective",
+                             op=op_name, bytes=nbytes)
             return out
 
         return wrapper

@@ -49,7 +49,7 @@ DEFAULT_FLUSH_S = 5.0
 
 __all__ = [
     "enabled", "telemetry_dir", "metrics", "timeline",
-    "inc", "set_gauge", "observe_value", "span", "instant",
+    "inc", "set_gauge", "observe_value", "span", "instant", "complete",
     "set_sink", "flush", "start_flusher", "stop_flusher",
     "snapshot_payload", "new_run_dir", "Registry", "Timeline",
     "set_flight_recorder",
@@ -146,6 +146,15 @@ def span(name, cat="", **args):
 def instant(name, cat="", **args):
     if enabled():
         _timeline.instant(name, cat=cat, **args)
+
+
+def complete(name, start, dur, cat="", tid=None, **args):
+    """Record a complete event with explicit wall-clock start and
+    duration (seconds) — for blocks whose endpoints the caller already
+    timed (the collective wrappers measure with ``perf_counter`` and
+    report here once)."""
+    if enabled():
+        _timeline.complete(name, start, dur, cat=cat, tid=tid, **args)
 
 
 # -- worker flush machinery --------------------------------------------------
@@ -251,6 +260,7 @@ def _reset_for_tests():
     _registry = Registry()
     _timeline = Timeline()
     _sink = None
-    from sparkdl_tpu.observe import health
+    from sparkdl_tpu.observe import health, perf
 
     health._reset_for_tests()
+    perf._reset_for_tests()

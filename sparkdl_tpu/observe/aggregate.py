@@ -41,6 +41,12 @@ TIMELINE_FILE = "timeline.json"
 PROM_FILE = "metrics.prom"
 JSON_FILE = "metrics.json"
 HEALTH_FILE = "health.json"
+PERF_FILE = "perf.json"
+
+# perf.json keeps the newest per-step attribution rows up to this cap
+# (the aggregate components cover the whole run either way) so a
+# week-long job's artifact stays readable.
+PERF_MAX_STEP_ROWS = 200
 
 DRIVER_LABEL = "driver"
 
@@ -198,6 +204,31 @@ class GangTelemetry:
             (PROM_FILE, render_prometheus(labeled)),
             (JSON_FILE, render_json(labeled, indent=2)),
         ]
+        # Per-rank step-time attribution (observe.perf): where each
+        # rank's step wall time went — compute vs collective vs host
+        # vs data wait vs checkpoint — plus overlap efficiency.
+        # Written only when at least one rank recorded step spans
+        # (serving run dirs have none).
+        from sparkdl_tpu.observe import perf as _perf
+
+        with self._lock:
+            rank_events = {r: list(evs)
+                           for r, evs in self._events.items()}
+        perf_ranks = {}
+        for rank in sorted(rank_events):
+            report = _perf.attribution_report(rank_events[rank])
+            if not report.get("steps"):
+                continue
+            per_step = report.get("per_step") or []
+            if len(per_step) > PERF_MAX_STEP_ROWS:
+                report["per_step"] = per_step[-PERF_MAX_STEP_ROWS:]
+                report["per_step_truncated"] = (
+                    len(per_step) - PERF_MAX_STEP_ROWS)
+            perf_ranks[str(rank)] = report
+        if perf_ranks:
+            files.append((PERF_FILE, json.dumps(
+                {"schema": _perf.BREAKDOWN_SCHEMA, "ranks": perf_ranks},
+                indent=2)))
         with self._lock:
             dumps = {r: list(d) for r, d in self._stack_dumps.items()}
             job_dirs = list(self._job_dirs)

@@ -98,6 +98,52 @@ def _gauge(rank_series, name, **labels):
     )
 
 
+def _diagnose_perf(run_dir, events, by_rank):
+    """"Where the time went" (or None): per-rank step-time attribution
+    — compute / collective / host-callback / data-wait / checkpoint
+    fractions plus overlap efficiency — from ``perf.json`` when the
+    aggregator wrote one, else recomputed from the merged timeline
+    (events grouped by lane; lane ``rank + 1`` is rank ``r``), plus
+    whatever MFU / achieved-FLOPs gauges the ranks exported. Pure
+    artifact math (:mod:`sparkdl_tpu.observe.perf` imports no jax) so
+    a copied run dir diagnoses anywhere."""
+    from sparkdl_tpu.observe import perf as _perf
+
+    ranks = {}
+    doc = _load_json(os.path.join(run_dir, "perf.json"))
+    if doc and isinstance(doc.get("ranks"), dict):
+        ranks = {str(r): rep for r, rep in doc["ranks"].items()
+                 if isinstance(rep, dict) and rep.get("steps")}
+    if not ranks:
+        by_lane = {}
+        for e in events:
+            pid = e.get("pid")
+            if isinstance(pid, int) and pid >= 1:
+                by_lane.setdefault(pid - 1, []).append(e)
+        for rank in sorted(by_lane):
+            rep = _perf.attribution_report(by_lane[rank])
+            if rep.get("steps"):
+                ranks[str(rank)] = rep
+    out = {}
+    for rank_s, rep in sorted(ranks.items()):
+        entry = {
+            "steps": rep.get("steps"),
+            "total_s": rep.get("total_s"),
+            "components": rep.get("components"),
+            "fractions": rep.get("fractions"),
+            "overlap_efficiency": rep.get("overlap_efficiency"),
+            "inter_step_data_wait_s": rep.get("inter_step_data_wait_s"),
+        }
+        series = by_rank.get(rank_s, {})
+        for name in ("mfu", "achieved_flops_per_sec"):
+            for (g_name, _labels), v in series.get("gauges", {}).items():
+                if g_name == name:
+                    entry[name] = v
+                    break
+        out[rank_s] = entry
+    return out or None
+
+
 def _diagnose_serving(events, by_rank, top_n=5):
     """Serving-run section (or None for pure gang dirs): slowest
     requests by TTFT, the admission rejection/deferral breakdown, and
@@ -283,6 +329,7 @@ def diagnose(run_dir):
         "recovered_from_flight_recorder": bool(ring_fresh),
         "flight_recorder_recovered_events": len(ring_fresh),
         "serving": _diagnose_serving(events, by_rank),
+        "perf": _diagnose_perf(run_dir, events, by_rank),
         "hang": verdict is not None,
         "verdict": verdict,
         "stalled_ranks": sorted(stalled),
@@ -349,6 +396,29 @@ def render_text(diag):
             f"NOTE: {diag.get('flight_recorder_recovered_events')} "
             "event(s) recovered from the flight-recorder ring "
             "(the process died before its final artifact write)")
+    perf = diag.get("perf")
+    if perf:
+        lines.append("where the time went (per step-thread second):")
+        for rank_s, p in sorted(perf.items(), key=lambda kv: kv[0]):
+            fr = p.get("fractions") or {}
+            parts = ", ".join(
+                f"{name.replace('_', ' ')} {fr[name] * 100:.1f}%"
+                for name in ("compute", "collective", "host_callback",
+                             "data_wait", "checkpoint")
+                if isinstance(fr.get(name), (int, float))
+                and fr[name] > 0.0005
+            )
+            line = (f"  rank {rank_s}: {parts or 'no attributed time'}"
+                    f" over {p.get('steps')} step(s)")
+            eff = p.get("overlap_efficiency")
+            if eff is not None:
+                line += f"; collective overlap {eff * 100:.0f}%"
+            if p.get("mfu") is not None:
+                line += f"; MFU {p['mfu'] * 100:.2f}%"
+            wait = p.get("inter_step_data_wait_s")
+            if isinstance(wait, (int, float)) and wait > 0.0005:
+                line += f"; +{wait:.3f}s data wait between steps"
+            lines.append(line)
     srv = diag.get("serving")
     if srv:
         codes = ", ".join(f"{c}: {n}" for c, n in

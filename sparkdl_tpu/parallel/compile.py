@@ -331,6 +331,7 @@ class CompiledStepCache:
                 "warm start: %s served from AOT cache in %.3fs "
                 "(fingerprint %s)", name, dt, fp[:12],
             )
+            self._register_cost(name, compiled, lowered)
             return compiled
         self.misses += 1
         with observe.span("compile", cat="compile", fn=name,
@@ -347,7 +348,25 @@ class CompiledStepCache:
                         fn=name, fingerprint=fp[:12],
                         seconds=round(dt, 4))
         self._write(path, fp, compiled)
+        self._register_cost(name, compiled, lowered)
         return compiled
+
+    @staticmethod
+    def _register_cost(name, compiled, lowered):
+        """Feed the executable's analytic FLOPs/bytes into
+        :mod:`sparkdl_tpu.observe.perf` so every instrumented step of
+        this program reports achieved-FLOPs/s and MFU. Behind the
+        telemetry latch inside ``register_step_cost``; a deserialized
+        executable whose runtime refuses the cost model falls back to
+        the lowering's estimate, and no cost model at all just means
+        the gauges never appear."""
+        from sparkdl_tpu import observe
+        from sparkdl_tpu.observe import perf
+
+        if not observe.enabled():
+            return
+        if perf.register_step_cost(name, compiled) is None:
+            perf.register_step_cost(name, lowered)
 
 
 def load_or_compile(lowered, *, name="train_step", compiler_options=None):

@@ -109,6 +109,13 @@ def instrument_step(step_fn, name="train_step"):
     accurate — a saturated pipeline's dispatch rate IS its device
     rate — and the compile-vs-execute split isolates the one honest
     outlier (the first call blocks on XLA anyway).
+
+    When an executable cost was registered for ``name``
+    (:func:`sparkdl_tpu.observe.perf.register_step_cost` — the
+    compile cache and :func:`lower_train_step` both do), each execute
+    call also updates the achieved-FLOPs/s, achieved-bytes/s, MFU and
+    memory-bandwidth-utilization gauges against the per-device-kind
+    peak table.
     """
     from sparkdl_tpu import observe
 
@@ -136,6 +143,9 @@ def instrument_step(step_fn, name="train_step"):
         observe.observe_value(f"{name}_seconds", dt, phase=phase)
         observe.inc(f"{name}_total", phase=phase)
         if phase == "execute":
+            from sparkdl_tpu.observe import perf
+
+            perf.note_step(name, dt)
             if state["first_exec_t0"] is None:
                 state["first_exec_t0"] = t0
             elapsed = time.perf_counter() - state["first_exec_t0"]
@@ -149,7 +159,8 @@ def instrument_step(step_fn, name="train_step"):
     return stepped
 
 
-def lower_train_step(step, *example_args, mesh=None):
+def lower_train_step(step, *example_args, mesh=None,
+                     cost_name="train_step"):
     """Version-stable lowered-module access for a (jitted or plain)
     train step: returns the ``jax.stages.Lowered`` for
     ``step(*example_args)``, entering ``mesh`` around lowering when
@@ -161,6 +172,13 @@ def lower_train_step(step, *example_args, mesh=None):
     twice. (Compilation is separate: lint the *Compiled* via
     ``analysis.lint_compiled`` / ``register_preflight`` when you will
     compile anyway, so the expensive compile runs once too.)
+
+    With telemetry opted in, the lowering's analytic FLOPs/bytes are
+    registered under ``cost_name`` so :func:`instrument_step` can
+    report achieved-FLOPs/s and MFU for it (the compile cache later
+    refines the estimate with the *compiled* cost model when the same
+    program goes through ``load_or_compile``). ``cost_name=None``
+    skips registration.
     """
     import contextlib
 
@@ -168,7 +186,14 @@ def lower_train_step(step, *example_args, mesh=None):
 
     ctx = mesh if mesh is not None else contextlib.nullcontext()
     with ctx:
-        return jax_compat.lower(step, *example_args)
+        lowered = jax_compat.lower(step, *example_args)
+    if cost_name is not None:
+        from sparkdl_tpu import observe
+        from sparkdl_tpu.observe import perf
+
+        if observe.enabled():
+            perf.register_step_cost(cost_name, lowered)
+    return lowered
 
 
 def shard_batch(batch, mesh, *, seq_axis=False):

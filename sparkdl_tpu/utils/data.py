@@ -18,8 +18,20 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     :param size: buffer depth (2 = classic double buffering).
     :param sharding: optional ``jax.sharding.Sharding`` (or pytree of
         them) for multi-chip placement; default = default device.
+
+    With telemetry opted in, each refill (host batch production +
+    dispatch of its device transfer) is a ``data.wait`` span on the
+    consuming thread. In the canonical ``for batch in
+    prefetch_to_device(...): stepped(batch)`` pattern these spans
+    fall BETWEEN the instrumented step windows, so a starved pipeline
+    surfaces as ``inter_step_data_wait_s`` in the ``observe.perf``
+    attribution report (the per-step ``data_wait`` component only
+    catches iterators consumed *inside* the step function). A
+    well-fed pipeline shows near-zero wait either way.
     """
     import jax
+
+    from sparkdl_tpu import observe
 
     queue = collections.deque()
 
@@ -29,13 +41,15 @@ def prefetch_to_device(iterator, size=2, sharding=None):
         else:
             queue.append(jax.device_put(batch, sharding))
 
-    for batch in itertools.islice(iterator, size):
-        put(batch)
+    with observe.span("data.wait", cat="data", phase="prime"):
+        for batch in itertools.islice(iterator, size):
+            put(batch)
     it = iterator
     while queue:
         out = queue.popleft()
-        for batch in itertools.islice(it, 1):
-            put(batch)
+        with observe.span("data.wait", cat="data"):
+            for batch in itertools.islice(it, 1):
+                put(batch)
         yield out
 
 

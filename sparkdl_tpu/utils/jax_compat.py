@@ -19,7 +19,10 @@ analysis subsystem builds on (``lower``, ``lowered_stablehlo``,
 ``compiled_hlo``, ``closed_jaxpr``, ``x64_enabled``), the
 warm-start-compilation shims (``enable_compilation_cache``,
 ``serialize_compiled``/``deserialize_compiled`` — see
-:mod:`sparkdl_tpu.parallel.compile`), and the runtime feature probe
+:mod:`sparkdl_tpu.parallel.compile`), the normalized cost-model
+accessors ``cost_analysis``/``memory_analysis`` (None-never-raise —
+:mod:`sparkdl_tpu.observe.perf` turns them into MFU/roofline gauges),
+and the runtime feature probe
 ``old_xla_spmd_partitioner()`` that tier-1 tests gate on instead of
 failing against the jax-0.4.x XLA.
 """
@@ -178,6 +181,58 @@ def deserialize_compiled(payload, in_tree, out_tree):
     )
 
     return deserialize_and_load(payload, in_tree, out_tree)
+
+
+def cost_analysis(executable):
+    """Normalized XLA cost model for a ``Lowered`` or ``Compiled``
+    (or anything duck-typed with a ``cost_analysis()``): a plain dict
+    with whichever of ``flops`` / ``bytes_accessed`` /
+    ``transcendentals`` the runtime reports, or **None** — never an
+    exception. Jax lines disagree on the return shape (0.4.x
+    ``Compiled`` returns a one-element list of dicts, ``Lowered`` and
+    newer lines a dict; some backends raise ``NotImplementedError``),
+    so every consumer goes through this normalization. The observe
+    layer divides these by step wall time into achieved-FLOPs/s and
+    MFU gauges (:mod:`sparkdl_tpu.observe.perf`)."""
+    try:
+        raw = executable.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    out = {}
+    for key, norm in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("transcendentals", "transcendentals")):
+        v = raw.get(key)
+        if isinstance(v, (int, float)) and v >= 0:
+            out[norm] = float(v)
+    return out or None
+
+
+def memory_analysis(executable):
+    """Normalized compiled-memory stats (``Compiled.memory_analysis``,
+    a ``CompiledMemoryStats`` on both jax lines): plain dict of the
+    ``*_size_in_bytes`` fields, or **None** — never an exception
+    (``Lowered`` has no memory analysis; neither do deserialized
+    executables on some runtimes)."""
+    try:
+        raw = executable.memory_analysis()
+    except Exception:
+        return None
+    if raw is None:
+        return None
+    out = {}
+    for key in ("argument_size_in_bytes", "output_size_in_bytes",
+                "temp_size_in_bytes", "alias_size_in_bytes",
+                "generated_code_size_in_bytes"):
+        v = getattr(raw, key, None) if not isinstance(raw, dict) \
+            else raw.get(key)
+        if isinstance(v, (int, float)) and v >= 0:
+            out[key] = int(v)
+    return out or None
 
 
 def device_memory_stats(device=None):

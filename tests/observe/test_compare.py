@@ -1,0 +1,253 @@
+"""observe.compare: the noise-aware perf diff + regression gate
+(ISSUE 7 tentpole, half b). All tier-1: pure-JSON fixtures, no gang,
+no jax. The contract under test is the CI gate's: identical runs exit
+0, an injected 20% slowdown exits non-zero, a noisy-but-flat metric
+passes, and cross-host comparisons degrade to advisory."""
+
+import json
+import os
+
+import pytest
+
+from sparkdl_tpu.observe import compare, perf
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _bench(value, *, metric="llama_lora_train_tokens_per_sec_cpu_proxy",
+           samples=None, **extra):
+    doc = {"metric": metric, "value": value, "unit": "tokens/sec"}
+    if samples is not None:
+        doc["rate_samples"] = samples
+    doc.update(extra)
+    return doc
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_identical_bench_runs_exit_zero(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _bench(1104.0))
+    b = _write(tmp_path, "b.json", _bench(1104.0))
+    assert compare.main([a, b]) == 0
+    assert "0 regression(s)" in capsys.readouterr().out
+
+
+def test_injected_20pct_slowdown_exits_nonzero(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _bench(1000.0))
+    cand = _write(tmp_path, "cand.json", _bench(800.0))
+    assert compare.main([base, cand]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_small_jitter_under_floor_passes(tmp_path):
+    base = _write(tmp_path, "base.json", _bench(1000.0))
+    cand = _write(tmp_path, "cand.json", _bench(970.0))  # -3% < 5% floor
+    assert compare.main([base, cand]) == 0
+
+
+def test_noisy_but_flat_iqr_passes(tmp_path):
+    """A metric whose rep samples have a wide IQR raises its own
+    threshold: a -15% median delta inside the noise band is not a
+    regression."""
+    base_s = [700, 900, 1000, 1100, 1300]  # rel-IQR = 200/1000 = 20%
+    cand_s = [600, 750, 850, 950, 1100]    # median -15%
+    base = _write(tmp_path, "base.json", _bench(1000.0, samples=base_s))
+    cand = _write(tmp_path, "cand.json", _bench(850.0, samples=cand_s))
+    assert compare.main([base, cand]) == 0
+    # the same delta on a quiet metric fails
+    base_q = _write(tmp_path, "bq.json", _bench(1000.0))
+    cand_q = _write(tmp_path, "cq.json", _bench(850.0))
+    assert compare.main([base_q, cand_q]) == 1
+
+
+def test_noise_band_does_not_hide_a_cliff(tmp_path):
+    base_s = [980, 995, 1000, 1005, 1020]  # rel-IQR 1%
+    cand_s = [round(s * 0.79, 1) for s in base_s]
+    base = _write(tmp_path, "base.json", _bench(1000.0, samples=base_s))
+    cand = _write(tmp_path, "cand.json", _bench(790.0, samples=cand_s))
+    assert compare.main([base, cand]) == 1
+
+
+def test_medians_beat_noisy_headline_values(tmp_path):
+    """The exact failure the gate must NOT produce: two runs of the
+    same code whose single-invocation headline values differ by >10%
+    but whose rep medians agree — green. (Observed live: 1910 vs
+    1664.7 tok/s on a 2-vCPU container, medians 0.3% apart.)"""
+    base = _write(tmp_path, "base.json", _bench(
+        1910.0, samples=[1910.0, 1741.4, 1903.6, 1714.2]))
+    cand = _write(tmp_path, "cand.json", _bench(
+        1664.7, samples=[1664.7, 1757.5, 1900.4, 1959.6]))
+    assert compare.main([base, cand]) == 0
+
+
+def test_lower_is_better_metrics_invert(tmp_path):
+    base = _write(tmp_path, "base.json",
+                  _bench(1.0, metric="headline_step_seconds"))
+    slower = _write(tmp_path, "slower.json",
+                    _bench(1.3, metric="headline_step_seconds"))
+    faster = _write(tmp_path, "faster.json",
+                    _bench(0.8, metric="headline_step_seconds"))
+    assert compare.main([base, slower]) == 1
+    assert compare.main([base, faster]) == 0
+
+
+def test_no_common_metrics_exits_two(tmp_path):
+    a = _write(tmp_path, "a.json", _bench(1.0, metric="m1"))
+    b = _write(tmp_path, "b.json", _bench(1.0, metric="m2"))
+    assert compare.main([a, b]) == 2
+
+
+def test_metric_filter_restricts_comparison(tmp_path):
+    rec_a = perf.history_record({"fast": 100.0, "slow": 100.0})
+    rec_b = perf.history_record({"fast": 100.0, "slow": 50.0})
+    a = _write(tmp_path, "a.json", rec_a)
+    b = _write(tmp_path, "b.json", rec_b)
+    assert compare.main([a, b]) == 1
+    assert compare.main([a, b, "--metric", "fast"]) == 0
+
+
+# -- record loading ---------------------------------------------------------
+
+
+def test_baseline_json_published_map_loads(tmp_path):
+    """The committed BASELINE.json is pretty-printed (embedded
+    newlines) — the loader must parse it as ONE document, and `_`
+    annotation keys are skipped."""
+    doc = {"published": {
+        "llama_lora_train_tokens_per_sec_cpu_proxy": 1104.0,
+        "_cpu_proxy_frozen": "round 6, deviceless container",
+    }}
+    p = tmp_path / "BASELINE.json"
+    p.write_text(json.dumps(doc, indent=2))
+    rec = compare.load_record(str(p))
+    assert rec["kind"] == "baseline"
+    assert rec["metrics"] == {
+        "llama_lora_train_tokens_per_sec_cpu_proxy": {"value": 1104.0}}
+
+
+def test_repo_baseline_vs_itself_passes():
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    baseline = os.path.join(root, "BASELINE.json")
+    assert compare.main([baseline, baseline]) == 0
+
+
+def test_history_ledger_default_and_indexed_selection(tmp_path):
+    path = tmp_path / "history.jsonl"
+    for v in (1000.0, 1100.0, 600.0):
+        perf.append_history(
+            perf.history_record({"tok_s": v}), str(path))
+    # default = newest entry
+    rec = compare.load_record(str(path))
+    assert rec["metrics"]["tok_s"]["value"] == 600.0
+    assert compare.load_record(
+        f"{path}@-2")["metrics"]["tok_s"]["value"] == 1100.0
+    assert compare.load_record(
+        f"{path}@0")["metrics"]["tok_s"]["value"] == 1000.0
+    # newest entry is a 45% regression vs entry 0 -> gate fires
+    assert compare.main([f"{path}@0", str(path)]) == 1
+    assert compare.main([f"{path}@0", f"{path}@-2"]) == 0
+
+
+def test_history_index_out_of_range_is_loud(tmp_path):
+    path = tmp_path / "history.jsonl"
+    perf.append_history(perf.history_record({"m": 1.0}), str(path))
+    with pytest.raises(SystemExit):
+        compare.load_record(f"{path}@7")
+
+
+def test_run_dir_loading_and_gate(tmp_path):
+    def run_dir(name, sps, step_mean):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "metrics.json").write_text(json.dumps({"series": [{
+            "labels": {"rank": "0", "host": "h"},
+            "gauges": [{"name": "train_step_per_second",
+                        "labels": {}, "value": sps}],
+            "histograms": [{"name": "train_step_seconds",
+                            "labels": {"phase": "execute"},
+                            "sum": step_mean * 10, "count": 10}],
+        }]}))
+        return str(d)
+
+    base = run_dir("run-a", 100.0, 0.010)
+    same = run_dir("run-b", 101.0, 0.0101)
+    slow = run_dir("run-c", 70.0, 0.0143)
+    assert compare.main([base, same]) == 0
+    assert compare.main([base, slow]) == 1
+    rec = compare.load_record(base)
+    assert "train_step_per_second[rank=0]" in rec["metrics"]
+    # the seconds-mean metric carries its lower-is-better marker
+    assert rec["metrics"]["train_step_seconds_mean[rank=0]"][
+        "higher_is_better"] is False
+
+
+def test_run_dir_without_metrics_json_is_loud(tmp_path):
+    d = tmp_path / "run-empty"
+    d.mkdir()
+    with pytest.raises(SystemExit):
+        compare.load_record(str(d))
+
+
+def test_unreadable_path_is_loud(tmp_path):
+    with pytest.raises(SystemExit):
+        compare.load_record(str(tmp_path / "nope.json"))
+
+
+# -- cross-host honesty -----------------------------------------------------
+
+
+def test_cross_host_regression_is_advisory_unless_strict(tmp_path,
+                                                         capsys):
+    rec_a = perf.history_record({"tok_s": 1000.0})
+    rec_b = perf.history_record({"tok_s": 700.0})
+    rec_a["host"], rec_b["host"] = "ci-runner/x86_64/cpu8", "laptop/arm64/cpu10"
+    a = _write(tmp_path, "a.json", rec_a)
+    b = _write(tmp_path, "b.json", rec_b)
+    assert compare.main([a, b]) == 0
+    assert "cross-host" in capsys.readouterr().out
+    assert compare.main([a, b, "--strict-host"]) == 1
+
+
+def test_same_host_regression_enforced(tmp_path):
+    rec_a = perf.history_record({"tok_s": 1000.0})
+    rec_b = perf.history_record({"tok_s": 700.0})
+    a = _write(tmp_path, "a.json", rec_a)
+    b = _write(tmp_path, "b.json", rec_b)
+    assert rec_a["host"] == rec_b["host"]
+    assert compare.main([a, b]) == 1
+
+
+# -- internals --------------------------------------------------------------
+
+
+def test_rel_iqr_math():
+    assert compare._rel_iqr(None) == 0.0
+    assert compare._rel_iqr([1, 2]) == 0.0  # too few samples
+    assert compare._rel_iqr([1000] * 8) == 0.0
+    assert compare._rel_iqr(
+        [700, 900, 1000, 1100, 1300]) == pytest.approx(0.2)
+
+
+def test_higher_is_better_heuristics():
+    assert compare._higher_is_better("tokens_per_sec")
+    assert not compare._higher_is_better("train_step_seconds_mean")
+    assert not compare._higher_is_better("ttft_p99")
+    # explicit marker beats the name
+    assert compare._higher_is_better("queue_seconds", explicit=True)
+
+
+def test_json_format_report(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _bench(1000.0))
+    b = _write(tmp_path, "b.json", _bench(700.0))
+    assert compare.main([a, b, "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["regressions"] == 1
+    (row,) = doc["metrics"]
+    assert row["status"] == "regression"
+    assert row["delta"] == pytest.approx(-0.3)
