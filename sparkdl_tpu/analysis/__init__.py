@@ -46,6 +46,7 @@ __all__ = [
     "max_severity", "register_pass", "run_passes", "lint_fn",
     "lint_lowered", "lint_compiled", "lint_gang", "param_info_from",
     "PreflightLintError", "PREFLIGHT_ENV", "register_preflight",
+    "register_gang_sharding",
 ]
 
 
@@ -77,23 +78,39 @@ def param_info_from(params, shardings):
             # counts as sharded (assuming size 1 instead would make
             # the all-gather pass vacuously green).
             spec = sh
+        spec_dims = ()
+        mesh_axes = ()
         if spec is not None:
             mesh_sizes = dict(
                 zip(sh.mesh.axis_names, sh.mesh.devices.shape)
             ) if hasattr(sh, "mesh") else {}
+            mesh_axes = tuple(sorted(
+                (str(k), int(v)) for k, v in mesh_sizes.items()
+            ))
             names = []
+            dims = []
             for entry in spec:
-                if entry is None:
-                    continue
+                dim_names = []
                 for n in (entry if isinstance(entry, tuple) else (entry,)):
-                    if n is not None and mesh_sizes.get(n, 2) > 1:
+                    if n is None:
+                        continue
+                    dim_names.append(str(n))
+                    if mesh_sizes.get(n, 2) > 1:
                         names.append(str(n))
+                dims.append(tuple(dim_names))
             axes = tuple(names)
+            # The sharding-tree-as-data idiom: the per-dim axis names,
+            # padded to the leaf's rank, so the reshard machinery can
+            # recompute partition counts under any TARGET mesh.
+            dims += [()] * (len(leaf.shape) - len(dims))
+            spec_dims = tuple(dims[:len(leaf.shape)])
         out.append(ParamInfo(
             path=key,
             shape=tuple(int(d) for d in leaf.shape),
             dtype=str(leaf.dtype),
             sharded_axes=axes,
+            spec=spec_dims,
+            mesh_axes=mesh_axes,
         ))
     return out
 
@@ -105,7 +122,7 @@ def _context_for(fn, args, *, compile=True, params=None, shardings=None,
     from sparkdl_tpu.utils import jax_compat
 
     ctx_mgr = mesh if mesh is not None else contextlib.nullcontext()
-    jaxpr = hlo_text = stablehlo = None
+    jaxpr = hlo_text = stablehlo = memory_stats = None
     with ctx_mgr:
         try:
             jaxpr = jax_compat.closed_jaxpr(fn, *args)
@@ -114,7 +131,9 @@ def _context_for(fn, args, *, compile=True, params=None, shardings=None,
         lowered = jax_compat.lower(fn, *args)
         stablehlo = jax_compat.lowered_stablehlo(lowered)
         if compile:
-            hlo_text = jax_compat.compiled_hlo(lowered)
+            compiled = lowered.compile()
+            hlo_text = compiled.as_text()
+            memory_stats = jax_compat.memory_analysis(compiled)
     info = None
     if params is not None and shardings is not None:
         info = param_info_from(params, shardings)
@@ -127,6 +146,7 @@ def _context_for(fn, args, *, compile=True, params=None, shardings=None,
         example_args=tuple(args),
         fn=fn,
         x64_enabled=jax_compat.x64_enabled(),
+        memory_stats=memory_stats,
         options=options or {},
     )
 
@@ -144,43 +164,108 @@ def lint_fn(fn, *args, compile=True, params=None, shardings=None,
     return run_passes(ctx, passes=passes)
 
 
-def lint_lowered(lowered, *, params=None, shardings=None, compile=True,
-                 passes=None, name=None, options=None):
-    """Lint an existing ``jax.stages.Lowered`` (compiling it for the
-    post-partitioning passes unless ``compile=False``)."""
+def _lowered_context(lowered, *, params=None, shardings=None,
+                     compile=True, name=None, options=None):
     from sparkdl_tpu.utils import jax_compat
 
     info = None
     if params is not None and shardings is not None:
         info = param_info_from(params, shardings)
-    ctx = GraphContext(
+    hlo_text = memory_stats = None
+    if compile:
+        compiled = lowered.compile()
+        hlo_text = compiled.as_text()
+        memory_stats = jax_compat.memory_analysis(compiled)
+    return GraphContext(
         fn_name=name or "<lowered>",
         jaxpr=getattr(lowered, "jaxpr", None),
-        hlo_text=jax_compat.compiled_hlo(lowered) if compile else None,
+        hlo_text=hlo_text,
         stablehlo_text=jax_compat.lowered_stablehlo(lowered),
         param_info=info,
         x64_enabled=jax_compat.x64_enabled(),
+        memory_stats=memory_stats,
         options=options or {},
     )
+
+
+def lint_lowered(lowered, *, params=None, shardings=None, compile=True,
+                 passes=None, name=None, options=None):
+    """Lint an existing ``jax.stages.Lowered`` (compiling it for the
+    post-partitioning passes unless ``compile=False``)."""
+    ctx = _lowered_context(
+        lowered, params=params, shardings=shardings, compile=compile,
+        name=name, options=options,
+    )
     return run_passes(ctx, passes=passes)
+
+
+def _compiled_context(compiled, *, params=None, shardings=None,
+                      name=None, options=None):
+    from sparkdl_tpu.utils import jax_compat
+
+    info = None
+    if params is not None and shardings is not None:
+        info = param_info_from(params, shardings)
+    return GraphContext(
+        fn_name=name or "<compiled>",
+        hlo_text=compiled.as_text(),
+        param_info=info,
+        x64_enabled=jax_compat.x64_enabled(),
+        memory_stats=jax_compat.memory_analysis(compiled),
+        options=options or {},
+    )
 
 
 def lint_compiled(compiled, *, params=None, shardings=None, passes=None,
                   name=None, options=None):
     """Lint an already-``Compiled`` executable's optimized HLO."""
-    from sparkdl_tpu.utils import jax_compat
-
-    info = None
-    if params is not None and shardings is not None:
-        info = param_info_from(params, shardings)
-    ctx = GraphContext(
-        fn_name=name or "<compiled>",
-        hlo_text=compiled.as_text(),
-        param_info=info,
-        x64_enabled=jax_compat.x64_enabled(),
-        options=options or {},
+    ctx = _compiled_context(
+        compiled, params=params, shardings=shardings, name=name,
+        options=options,
     )
     return run_passes(ctx, passes=passes)
+
+
+def register_gang_sharding(params, shardings, mesh=None, *,
+                           local_device_count=None, hbm_bytes=None,
+                           state_multiplier=3.0):
+    """Register the gang's live sharding tree for the supervisor's
+    elastic-relaunch pre-flight (``SPARKDL_TPU_GANG_RELAUNCH_NP``):
+    before relaunching at a different ``np`` the supervisor runs
+    :func:`sparkdl_tpu.analysis.comms.reshard_plan` against this tree
+    and refuses an infeasible shrink with a typed
+    :class:`~sparkdl_tpu.analysis.comms.ReshardPreflightError` —
+    instead of an OOM (or an indivisible-shard crash) mid-restore.
+
+    Driver-side, never pickled — same contract as
+    :func:`register_preflight`::
+
+        analysis.register_gang_sharding(params, shardings, mesh)
+        HorovodRunner(np=8).run(main)
+    """
+    from sparkdl_tpu.analysis import comms
+
+    info = param_info_from(params, shardings)
+    axes = {}
+    if mesh is not None:
+        axes = {
+            str(k): int(v)
+            for k, v in zip(mesh.axis_names, mesh.devices.shape)
+        }
+    else:
+        for i in info:
+            axes.update(dict(i.mesh_axes))
+    # local_device_count stays explicit-only: the DRIVER's
+    # jax.local_device_count() is not the gang's per-host chip count
+    # (a driver that forced host devices to lower the program would
+    # bake that in and falsely refuse feasible relaunches — a refusal
+    # is exactly the failure this gate exists to prevent). Without it
+    # the whole-host placement check is skipped, like any other
+    # unprovable property.
+    return comms.register_gang_sharding(
+        info, axes, local_device_count=local_device_count,
+        hbm_bytes=hbm_bytes, state_multiplier=state_multiplier,
+    )
 
 
 def lint_gang(fns_or_jaxprs, args_per_rank=None, names=None):

@@ -20,7 +20,7 @@ import sys
 from sparkdl_tpu.analysis.core import Severity, max_severity
 
 
-def _graft_findings(n_devices):
+def _graft_findings(n_devices, with_comms=False):
     import os
 
     flags = os.environ.get("XLA_FLAGS", "")
@@ -43,16 +43,45 @@ def _graft_findings(n_devices):
     spec.loader.exec_module(mod)
     step, params, opt_state, batch, mesh, shardings = \
         mod.build_multichip_step(n_devices)
-    from sparkdl_tpu.analysis import lint_fn
+    from sparkdl_tpu.analysis import _context_for, run_passes
 
-    # lint_fn (not lint_lowered) so the jaxpr-level passes — collective
-    # consistency, host-sync — see through the step, not just its
-    # compiled HLO.
-    return lint_fn(
-        step, params, opt_state, batch, mesh=mesh,
-        params=params, shardings=shardings,
-        name=f"build_multichip_step({n_devices})",
+    name = f"build_multichip_step({n_devices})"
+    # One context (one trace, ONE compile) feeds both the pass suite
+    # and the comms budget; built like lint_fn (not lint_lowered) so
+    # the jaxpr-level passes — collective consistency, host-sync — see
+    # through the step, not just its compiled HLO.
+    ctx = _context_for(
+        step, (params, opt_state, batch), compile=True, params=params,
+        shardings=shardings, mesh=mesh, name=name,
+        options={"n_devices": n_devices},
     )
+    findings = run_passes(ctx)
+    report = None
+    if with_comms:
+        from sparkdl_tpu.analysis import comms
+
+        report = comms.comms_report(
+            ctx.hlo_text, n_devices=n_devices, name=name,
+        )
+    return findings, report
+
+
+def _render_comms(report):
+    t = report["totals"]
+    lines = [
+        f"comms budget [{report['name']}] — {t['count']} collective(s), "
+        f"{t['wire_bytes_per_device'] / 2**20:.2f} MiB/device on the "
+        f"wire, ~{t['predicted_s'] * 1e3:.3f} ms/step predicted on "
+        f"{report['device_kind']} "
+        f"(ici={report['ici_bytes_per_sec']:.2e} B/s, ring assumption)"
+    ]
+    for kind, agg in sorted(report["totals"]["by_kind"].items()):
+        lines.append(
+            f"  {kind:20s} x{agg['count']:<3d} "
+            f"{agg['wire_bytes_per_device'] / 2**20:9.2f} MiB  "
+            f"~{agg['predicted_s'] * 1e3:8.3f} ms"
+        )
+    return "\n".join(lines)
 
 
 def main(argv=None):
@@ -74,6 +103,17 @@ def main(argv=None):
         help="graph-lint the N-device multichip driver program",
     )
     parser.add_argument(
+        "--comms", action="store_true",
+        help="also emit the static communication budget (per-collective"
+             " bytes-on-the-wire + predicted seconds) for the --graft "
+             "program",
+    )
+    parser.add_argument(
+        "--comms-out", metavar="PATH", default=None,
+        help="write the comms report JSON to PATH (CI artifact); "
+             "implies --comms",
+    )
+    parser.add_argument(
         "--format", choices=("text", "json"), default="text",
     )
     parser.add_argument(
@@ -86,6 +126,12 @@ def main(argv=None):
         "--list-passes", action="store_true",
         help="print the registered graph passes and exit",
     )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the FULL rule catalog — graph passes plus the "
+             "AST/pre-flight rules — as (rule id, severities, "
+             "one-liner) and exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_passes:
@@ -96,22 +142,47 @@ def main(argv=None):
                   f"  {p.doc}")
         return 0
 
+    if args.list_rules:
+        from sparkdl_tpu.analysis.core import rule_catalog
+
+        for rule_id, (severities, doc) in rule_catalog().items():
+            sev = "/".join(severities) or "-"
+            print(f"{rule_id:28s} {sev:16s} {doc}")
+        return 0
+
     from sparkdl_tpu.analysis.selflint import lint_paths, self_targets
 
+    want_comms = args.comms or args.comms_out is not None
+    if want_comms and args.graft is None:
+        parser.error("--comms needs --graft N (the budget is priced "
+                     "from a compiled program)")
     findings = []
+    comms_reports = []
     targets = list(args.paths)
     if args.self_lint:
         targets.extend(self_targets())
     if targets:
         findings.extend(lint_paths(targets))
     if args.graft is not None:
-        findings.extend(_graft_findings(args.graft))
+        graft_findings, report = _graft_findings(
+            args.graft, with_comms=want_comms)
+        findings.extend(graft_findings)
+        if report is not None:
+            comms_reports.append(report)
     if not targets and args.graft is None:
         parser.error("nothing to lint: give paths, --self, or --graft N")
 
+    if args.comms_out and comms_reports:
+        from sparkdl_tpu.analysis.comms import write_report
+
+        write_report(comms_reports, args.comms_out)
+
     findings.sort(key=lambda f: -int(f.severity))
     if args.format == "json":
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
+        doc = [f.to_dict() for f in findings]
+        if want_comms:
+            doc = {"findings": doc, "comms_reports": comms_reports}
+        print(json.dumps(doc, indent=2))
     else:
         for f in findings:
             print(f)
@@ -119,6 +190,8 @@ def main(argv=None):
         n_warn = sum(1 for f in findings if f.severity == Severity.WARNING)
         print(f"-- {len(findings)} finding(s): {n_err} error(s), "
               f"{n_warn} warning(s)")
+        for report in comms_reports:
+            print(_render_comms(report))
     if args.fail_on != "never":
         top = max_severity(findings)
         if top is not None and top >= Severity.parse(args.fail_on):

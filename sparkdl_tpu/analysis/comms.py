@@ -1,0 +1,552 @@
+"""Static communication & resharding cost model.
+
+The paper's ``np=-1`` contract ("use what the cluster has") and the
+elastic-relaunch arc both hinge on questions the runtime can only
+answer after an expensive — or fatal — launch: how many bytes will
+this step move over which collectives, will the params fit after
+resharding to a shrunken mesh, and which barrier-style collectives are
+hideable under compute. This module answers them **statically**, from
+the compiled module text and the sharding trees, before a single chip
+is claimed:
+
+- :func:`comms_report` — walk the post-partitioning HLO collectives
+  (all-reduce, all-gather, reduce-scatter, all-to-all,
+  collective-permute), decode their replica groups, and price each op
+  in bytes-on-the-wire per device under a **ring-algorithm**
+  assumption, then in predicted seconds against the per-device-kind
+  interconnect row of :data:`sparkdl_tpu.observe.perf.PEAK_TABLE`.
+  The report is machine-readable (schema below) and is the artifact
+  the CLI (``--comms``), the launcher pre-flight, CI, and
+  ``observe.doctor``'s predicted-vs-measured section all share.
+
+- :func:`reshard_plan` — feasibility of re-laying a sharding tree
+  onto a *target* mesh: per-dim divisibility, per-host placement, and
+  the restore-time high-water mark (old shard + new shard resident
+  while the reshard is in flight). The supervisor consults it via
+  :func:`check_relaunch_np` before relaunching a gang at a different
+  ``np``, so an infeasible shrink fails fast with a typed
+  :class:`ReshardPreflightError` instead of an OOM mid-restore.
+
+Ring assumption, documented once: every collective is priced as its
+bandwidth-optimal ring variant — each device sends/receives
+``(n-1)/n`` of the data per pass, all-reduce pays two passes
+(reduce-scatter + all-gather). Tree/hierarchical algorithms trade
+latency for the same asymptotic bytes, so the budget is a floor that
+real launches should sit within a small factor of — the gang
+cross-check test holds predicted-vs-measured within 2x.
+
+Import rule: importing this module never imports jax (the launcher
+touches the analysis package on every gang start); numpy is only
+reached lazily through :func:`sparkdl_tpu.analysis.hlo.groups_of`.
+"""
+
+import json
+import re
+from dataclasses import dataclass, field
+
+from sparkdl_tpu.analysis import hlo as hlo_mod
+from sparkdl_tpu.analysis.core import (
+    Finding,
+    Severity,
+    register_rule_info,
+)
+from sparkdl_tpu.analysis.preflight import PreflightLintError
+
+COMMS_SCHEMA = "sparkdl_tpu.analysis.comms_report/1"
+
+register_rule_info(
+    "reshard-infeasible", ("ERROR",),
+    "Elastic-relaunch pre-flight: the sharding tree cannot be re-laid "
+    "onto the target mesh (indivisible dim, fractional-host placement, "
+    "or restore high-water over the HBM budget).",
+)
+
+# Worker/launcher-visible target np for an elastic relaunch, shipped by
+# the supervisor once the reshard pre-flight clears it (the launcher
+# honoring it end-to-end is the elastic-gang arc; the env contract and
+# the feasibility gate land here). Same literal as
+# sparkdl_tpu.horovod.supervisor.RELAUNCH_NP_ENV — duplicated so the
+# supervisor never imports this package at import time; a test pins
+# the two spellings together.
+RELAUNCH_NP_ENV = "SPARKDL_TPU_GANG_RELAUNCH_NP"
+
+# HLO shorthand element widths (bytes). Mirrors the numpy-name map the
+# donation pass keeps for MLIR types; HLO result types spell dtypes
+# f32/bf16/s32/pred, so the keys differ.
+HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1,
+    "pred": 1, "c64": 8, "c128": 16,
+}
+
+# numpy-style dtype name -> bytes, for ParamInfo trees.
+DTYPE_BYTES = {
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "int64": 8, "int32": 4, "int16": 2, "int8": 1,
+    "uint64": 8, "uint32": 4, "uint16": 2, "uint8": 1, "bool": 1,
+    "complex64": 8, "complex128": 16,
+}
+
+
+def _elements(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def param_nbytes(info):
+    """Full (unsharded) bytes of one :class:`ParamInfo` leaf."""
+    return _elements(info.shape) * DTYPE_BYTES.get(info.dtype, 4)
+
+
+def collective_wire_bytes(kind, result_bytes, group_size):
+    """Per-device bytes-on-the-wire for one collective, given its
+    RESULT size in bytes and its group size, under the ring
+    assumption:
+
+    - ``all-reduce``: result == input; ring reduce-scatter +
+      all-gather moves ``2 * (n-1)/n * payload`` per device.
+    - ``all-gather``: result is the gathered (full) tensor; each
+      device receives the other ``n-1`` shards: ``(n-1)/n * full``.
+    - ``reduce-scatter``: result is one shard; the input was ``n``
+      shards and each device ships ``n-1`` of them: ``(n-1) * shard``.
+    - ``all-to-all``: every device keeps ``1/n`` and sends the rest:
+      ``(n-1)/n * payload``.
+    - ``collective-permute`` / ``collective-broadcast``: one full copy
+      of the payload crosses each device's links.
+
+    ``group_size <= 1`` (or unknown, passed as ``None``) means no
+    wire traffic can be proven — returns 0 — except for
+    permute/broadcast, whose cost is one payload copy *regardless* of
+    group size, so an unknown group still prices honestly.
+    """
+    n = group_size or 0
+    if kind in ("collective-permute", "collective-broadcast"):
+        return float(result_bytes) if n != 1 else 0.0
+    if n <= 1:
+        return 0.0
+    if kind == "all-reduce":
+        return 2.0 * (n - 1) / n * result_bytes
+    if kind == "all-gather":
+        return (n - 1) / n * result_bytes
+    if kind == "reduce-scatter":
+        return float((n - 1) * result_bytes)
+    if kind == "all-to-all":
+        return (n - 1) / n * result_bytes
+    # collective-permute, collective-broadcast, anything new: one
+    # payload copy per device is the conservative floor.
+    return float(result_bytes)
+
+
+_NUM_PARTITIONS_RE = re.compile(r"\bnum_partitions=(\d+)")
+_REPLICA_COUNT_RE = re.compile(r"\breplica_count=(\d+)")
+
+
+def _module_device_count(hlo_text):
+    """Device count the HLO module header declares
+    (``num_partitions`` x ``replica_count``, each defaulting to 1), or
+    ``None`` when the header names neither."""
+    parts = _NUM_PARTITIONS_RE.search(hlo_text or "")
+    reps = _REPLICA_COUNT_RE.search(hlo_text or "")
+    if parts is None and reps is None:
+        return None
+    return (int(parts.group(1)) if parts else 1) * \
+        (int(reps.group(1)) if reps else 1)
+
+
+def group_size_of(col, n_devices=None):
+    """Participant count of one :class:`HloCollective`: the size of
+    its (first) replica group, or ``n_devices`` when the groups are
+    unconstrained (``{}`` means "everyone"), or ``None`` when neither
+    is knowable from the text alone."""
+    if col.kind == "collective-permute":
+        # Permutes carry source_target_pairs, not replica_groups; the
+        # wire cost is one payload per device regardless, so the
+        # group size only labels the report.
+        return n_devices
+    groups = hlo_mod.groups_of(col)
+    if groups:
+        return max(len(g) for g in groups)
+    return n_devices
+
+
+def _result_bytes(col):
+    # An async "-start" with a tuple result carries the op's INPUT
+    # buffer alongside the output ((in, out) for all-gather-start /
+    # collective-permute-start, plus u32 context scalars on some
+    # lines); summing all members would double-count the payload.
+    # Member [1] is the output by XLA convention — the value the
+    # matching "-done" yields. Sync ops (and single-typed async
+    # all-reduce-start) sum their members: a tuple there IS several
+    # payloads combined into one collective.
+    types = col.result_types
+    if col.async_start and len(types) > 1:
+        types = types[1:2]
+    total = 0
+    for dtype, shape in types:
+        total += _elements(shape) * HLO_DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def comms_report(hlo_text, *, n_devices=None, device_kind=None,
+                 ici_bytes_per_sec=None, name="<module>"):
+    """Price every collective in a post-partitioning HLO module.
+
+    Returns the machine-readable comms report (schema
+    ``sparkdl_tpu.analysis.comms_report/1``)::
+
+        {"schema": ..., "name": ..., "device_kind": ...,
+         "ici_bytes_per_sec": float,
+         "assumptions": {"algorithm": "ring", ...},
+         "collectives": [{"index", "kind", "dtype", "shape",
+                          "group_size", "async_start",
+                          "result_bytes", "wire_bytes_per_device",
+                          "predicted_s"}, ...],
+         "totals": {"count", "wire_bytes_per_device", "predicted_s",
+                    "by_kind": {kind: {"count", "wire_bytes_per_device",
+                                       "predicted_s"}}}}
+
+    ``predicted_s`` divides per-device wire bytes by the device kind's
+    interconnect row in :data:`sparkdl_tpu.observe.perf.PEAK_TABLE`
+    (override with ``ici_bytes_per_sec``); the total assumes
+    barrier-style (serialized) collectives — the same worst case the
+    ``unoverlapped-collective`` pass reports against.
+
+    ``n_devices`` defaults to what the module header itself declares
+    (``num_partitions`` × ``replica_count``) — the pre-flight path
+    prices compiled modules without knowing the gang size up front.
+    """
+    from sparkdl_tpu.observe import perf
+
+    if n_devices is None:
+        n_devices = _module_device_count(hlo_text)
+    kind = device_kind or perf.device_kind() or "cpu"
+    ici = (float(ici_bytes_per_sec) if ici_bytes_per_sec
+           else perf.peak_interconnect_bytes_per_sec(kind))
+    entries = []
+    by_kind = {}
+    for col in hlo_mod.collectives(hlo_text):
+        n = group_size_of(col, n_devices=n_devices)
+        rbytes = _result_bytes(col)
+        wire = collective_wire_bytes(col.kind, rbytes, n)
+        secs = wire / ici if ici else None
+        entries.append({
+            "index": col.index,
+            "kind": col.kind,
+            "dtype": col.dtype,
+            "shape": list(col.shape),
+            "group_size": n,
+            "async_start": col.async_start,
+            "result_bytes": rbytes,
+            "wire_bytes_per_device": wire,
+            "predicted_s": secs,
+        })
+        agg = by_kind.setdefault(
+            col.kind,
+            {"count": 0, "wire_bytes_per_device": 0.0, "predicted_s": 0.0},
+        )
+        agg["count"] += 1
+        agg["wire_bytes_per_device"] += wire
+        agg["predicted_s"] += secs or 0.0
+    return {
+        "schema": COMMS_SCHEMA,
+        "name": name,
+        "device_kind": kind,
+        "ici_bytes_per_sec": ici,
+        "assumptions": {
+            "algorithm": "ring",
+            "serialized": True,
+            "n_devices": n_devices,
+        },
+        "collectives": entries,
+        "totals": {
+            "count": len(entries),
+            "wire_bytes_per_device": sum(
+                e["wire_bytes_per_device"] for e in entries),
+            "predicted_s": sum(e["predicted_s"] or 0.0 for e in entries),
+            "by_kind": by_kind,
+        },
+    }
+
+
+def write_report(report, path):
+    """Write one comms report as JSON (the CI artifact / run-dir
+    ``comms_report.json`` shape: a list of reports under
+    ``{"reports": [...]}`` when given a list)."""
+    doc = report if isinstance(report, dict) and "reports" in report \
+        else {"reports": report if isinstance(report, list) else [report]}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return path
+
+
+# -- resharding feasibility --------------------------------------------------
+
+
+class ReshardPreflightError(PreflightLintError):
+    """The reshard pre-flight proved the target mesh infeasible; the
+    relaunch was refused before any slot was claimed. ``.findings``
+    (inherited) names every failing param/axis; ``.plan`` carries the
+    full :class:`ReshardPlan`."""
+
+    def __init__(self, findings, plan=None):
+        super().__init__(findings)
+        self.plan = plan
+        # Replace the inherited preamble: this gate is keyed by the
+        # relaunch-np env, not the lint knob — telling the operator to
+        # unset SPARKDL_TPU_PREFLIGHT_LINT here would be wrong advice.
+        lines = "\n".join(f"  {f}" for f in self.findings)
+        self.args = (
+            "elastic relaunch refused: the registered sharding tree "
+            f"cannot be re-laid onto the target mesh (unset "
+            f"{RELAUNCH_NP_ENV} or pick a feasible np):\n{lines}",
+        )
+
+
+def _dim_partitions(spec_entry, axes):
+    n = 1
+    for a in (spec_entry or ()):
+        n *= int(axes.get(a, 1))
+    return n
+
+
+def _shard_factor(info, axes):
+    """How many ways ``axes`` split this leaf (product over dims)."""
+    factor = 1
+    for dim in range(len(info.shape)):
+        spec = info.spec[dim] if dim < len(info.spec) else ()
+        factor *= _dim_partitions(spec, axes)
+    return factor
+
+
+@dataclass
+class ReshardPlan:
+    """Feasibility verdict + sizing for re-laying one sharding tree
+    onto a target mesh. ``problems`` are :class:`Finding`s (ERROR =
+    infeasible); byte figures assume the whole tree (params plus
+    ``state_multiplier``x for optimizer state riding param shapes)."""
+
+    source_axes: dict
+    target_axes: dict
+    problems: list = field(default_factory=list)
+    state_bytes_total: int = 0
+    per_device_bytes_source: int = 0
+    per_device_bytes_target: int = 0
+    transfer_bytes_per_device: int = 0
+    restore_high_water_bytes: int = 0
+    hbm_bytes: float = None
+
+    @property
+    def feasible(self):
+        return not any(
+            p.severity >= Severity.ERROR for p in self.problems
+        )
+
+    def to_dict(self):
+        return {
+            "source_axes": dict(self.source_axes),
+            "target_axes": dict(self.target_axes),
+            "feasible": self.feasible,
+            "problems": [p.to_dict() for p in self.problems],
+            "state_bytes_total": self.state_bytes_total,
+            "per_device_bytes_source": self.per_device_bytes_source,
+            "per_device_bytes_target": self.per_device_bytes_target,
+            "transfer_bytes_per_device": self.transfer_bytes_per_device,
+            "restore_high_water_bytes": self.restore_high_water_bytes,
+            "hbm_bytes": self.hbm_bytes,
+        }
+
+
+def reshard_plan(param_info, source_axes, target_axes, *,
+                 local_device_count=None, hbm_bytes=None,
+                 state_multiplier=3.0):
+    """Check that ``param_info`` (ParamInfo leaves with ``spec`` — see
+    :func:`sparkdl_tpu.parallel.sharding.sharding_tree_info`) can be
+    re-laid onto ``target_axes`` (mesh axis name -> size).
+
+    Checks, in order:
+
+    1. **Divisibility** — every sharded dim of every leaf must divide
+       by the product of its spec axes' *target* sizes (axes absent
+       from the target mesh count as 1 = replicated). An indivisible
+       leaf is an ERROR naming the param and the axis.
+    2. **Per-host placement** — with ``local_device_count`` given, the
+       target mesh size must be a whole number of hosts (a mesh that
+       strands a fraction of a host's chips cannot be gang-launched).
+    3. **Restore high-water** — while a reshard-on-restore is in
+       flight a device holds its *new* shard plus (worst case) one
+       *old* shard of everything: with ``hbm_bytes`` given (default:
+       the probed device kind's capacity), exceeding it is an ERROR —
+       the shrink that OOMs mid-restore, caught on the driver.
+
+    ``state_multiplier`` scales raw param bytes to full train state
+    (params + adamw mu + nu = 3.0); pass 1.0 for inference trees.
+    """
+    if hbm_bytes is None:
+        from sparkdl_tpu.observe import perf
+
+        hbm_bytes = perf.hbm_capacity_bytes()
+    problems = []
+    total = 0
+    src_dev = 0.0
+    tgt_dev = 0.0
+    for info in param_info or []:
+        nbytes = param_nbytes(info) * state_multiplier
+        total += nbytes
+        src_dev += nbytes / _shard_factor(info, source_axes)
+        for dim in range(len(info.shape)):
+            spec = info.spec[dim] if dim < len(info.spec) else ()
+            parts = _dim_partitions(spec, target_axes)
+            if parts > 1 and info.shape[dim] % parts:
+                axes_s = "/".join(spec)
+                problems.append(Finding(
+                    rule_id="reshard-infeasible",
+                    severity=Severity.ERROR,
+                    op=info.path,
+                    location="",
+                    message=(
+                        f"param {info.path} dim {dim} (size "
+                        f"{info.shape[dim]}) does not divide by "
+                        f"{parts} (target mesh axis '{axes_s}'): the "
+                        "shrunken mesh cannot shard this leaf; change "
+                        "the target np or reshape the param."
+                    ),
+                ))
+        tgt_dev += nbytes / _shard_factor(info, target_axes)
+    mesh_size = 1
+    for v in target_axes.values():
+        mesh_size *= int(v)
+    if local_device_count and mesh_size % int(local_device_count):
+        problems.append(Finding(
+            rule_id="reshard-infeasible",
+            severity=Severity.ERROR,
+            op="mesh",
+            location="",
+            message=(
+                f"target mesh of {mesh_size} device(s) is not a whole "
+                f"number of hosts ({local_device_count} local "
+                "device(s) each): a gang cannot claim a fraction of a "
+                "host's chips."
+            ),
+        ))
+    # Worst-case restore: the new (target) shard of everything plus
+    # one old (source) shard of everything resident at once.
+    high_water = int(tgt_dev + src_dev)
+    if hbm_bytes and high_water > hbm_bytes:
+        problems.append(Finding(
+            rule_id="reshard-infeasible",
+            severity=Severity.ERROR,
+            op="hbm",
+            location="",
+            message=(
+                f"restore high-water {high_water / 2**30:.2f} GiB "
+                f"(new shard {tgt_dev / 2**30:.2f} + old shard "
+                f"{src_dev / 2**30:.2f}) exceeds the per-device HBM "
+                f"budget {hbm_bytes / 2**30:.2f} GiB: this shrink "
+                "OOMs mid-restore. Target a larger np or stream the "
+                "restore."
+            ),
+        ))
+    return ReshardPlan(
+        source_axes=dict(source_axes),
+        target_axes=dict(target_axes),
+        problems=problems,
+        state_bytes_total=int(total),
+        per_device_bytes_source=int(src_dev),
+        per_device_bytes_target=int(tgt_dev),
+        transfer_bytes_per_device=int(tgt_dev),
+        restore_high_water_bytes=high_water,
+        hbm_bytes=hbm_bytes,
+    )
+
+
+def shrink_mesh(source_axes, target_np):
+    """Re-derive a mesh for ``target_np`` devices from ``source_axes``:
+    model/seq (the axes that change the program) are preserved, the
+    data-like axes (data, fsdp) absorb the change — fsdp kept when the
+    remainder still divides by it, else collapsed into data. Returns
+    ``(axes_dict, None)`` or ``(None, reason)``."""
+    model = int(source_axes.get("model", 1))
+    seq = int(source_axes.get("seq", 1))
+    fixed = model * seq
+    if target_np < fixed or target_np % fixed:
+        return None, (
+            f"target np={target_np} is not a multiple of the "
+            f"preserved model*seq axes ({model}*{seq}={fixed})"
+        )
+    remaining = target_np // fixed
+    fsdp = int(source_axes.get("fsdp", 1))
+    if fsdp > 1 and remaining % fsdp == 0:
+        return ({"data": remaining // fsdp, "fsdp": fsdp,
+                 "seq": seq, "model": model}, None)
+    return ({"data": remaining, "fsdp": 1, "seq": seq,
+             "model": model}, None)
+
+
+# -- gang sharding registration (the supervisor's pre-flight input) ----------
+
+_GANG_SHARDING = None
+
+
+def register_gang_sharding(param_info, source_axes, *,
+                           local_device_count=None, hbm_bytes=None,
+                           state_multiplier=3.0):
+    """Register the running gang's sharding tree so the supervisor can
+    feasibility-check an elastic relaunch (``SPARKDL_TPU_GANG_RELAUNCH_NP``)
+    before claiming slots. Driver-side, never pickled. Prefer the
+    jax-aware wrapper ``sparkdl_tpu.analysis.register_gang_sharding``
+    which builds ``param_info``/axes from live (params, shardings,
+    mesh)."""
+    global _GANG_SHARDING
+    _GANG_SHARDING = {
+        "param_info": list(param_info),
+        "source_axes": dict(source_axes),
+        "local_device_count": local_device_count,
+        "hbm_bytes": hbm_bytes,
+        "state_multiplier": state_multiplier,
+    }
+    return _GANG_SHARDING
+
+
+def registered_gang_sharding():
+    return _GANG_SHARDING
+
+
+def clear_gang_sharding():
+    """Drop the registered tree (test isolation)."""
+    global _GANG_SHARDING
+    _GANG_SHARDING = None
+
+
+def check_relaunch_np(target_np):
+    """Supervisor hook: feasibility of relaunching the registered gang
+    at ``target_np``. Returns the :class:`ReshardPlan` (or ``None``
+    when no sharding tree was registered — nothing provable, the
+    relaunch proceeds unchecked); raises
+    :class:`ReshardPreflightError` naming the failing param/axis when
+    the shrink/grow is infeasible."""
+    reg = _GANG_SHARDING
+    if reg is None:
+        return None
+    target_axes, reason = shrink_mesh(reg["source_axes"], int(target_np))
+    if target_axes is None:
+        raise ReshardPreflightError([Finding(
+            rule_id="reshard-infeasible",
+            severity=Severity.ERROR,
+            op="mesh",
+            location="",
+            message=f"no target mesh for np={target_np}: {reason}",
+        )])
+    plan = reshard_plan(
+        reg["param_info"], reg["source_axes"], target_axes,
+        local_device_count=reg["local_device_count"],
+        hbm_bytes=reg["hbm_bytes"],
+        state_multiplier=reg["state_multiplier"],
+    )
+    if not plan.feasible:
+        raise ReshardPreflightError(plan.problems, plan=plan)
+    return plan

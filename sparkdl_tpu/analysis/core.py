@@ -68,12 +68,21 @@ class Finding:
 class ParamInfo:
     """One parameter leaf as the graph passes see it: full (unsharded)
     shape/dtype plus the mesh axes its sharding actually splits it
-    over (axes of size 1 don't count — XLA normalizes them away)."""
+    over (axes of size 1 don't count — XLA normalizes them away).
+
+    ``spec`` is the per-dimension sharding as data — one tuple of mesh
+    axis names per dim, ``()`` for an unsharded dim — and
+    ``mesh_axes`` the sorted ``(axis_name, size)`` pairs of the mesh
+    the sharding was built against: together they let the reshard /
+    implicit-reshard machinery recompute per-dim partition counts
+    under any *target* mesh without holding a live jax sharding."""
 
     path: str
     shape: tuple
     dtype: str
     sharded_axes: tuple
+    spec: tuple = ()
+    mesh_axes: tuple = ()
 
     @property
     def elements(self):
@@ -96,6 +105,7 @@ class GraphContext:
     example_args: tuple = None    # the concrete/abstract args traced with
     fn: object = None             # the callable itself (shadow retraces)
     x64_enabled: bool = None      # jax_enable_x64 at trace time
+    memory_stats: dict = None     # jax_compat.memory_analysis(compiled)
     options: dict = field(default_factory=dict)
 
 
@@ -105,20 +115,35 @@ class GraphPass:
     fn: object
     requires: tuple
     doc: str
+    severities: tuple = ()
 
 
 _REGISTRY = {}
 
+# Non-graph rules (AST lint, reshard pre-flight) announce themselves
+# here so the CLI's --list-rules catalog — and the docs-drift test
+# pinning docs/analysis.rst against it — covers the FULL rule surface,
+# not just the GraphContext passes.
+_EXTRA_RULES = {}
 
-def register_pass(rule_id, requires=()):
+
+def register_rule_info(rule_id, severities, doc):
+    """Catalog entry for a rule that is not a registered graph pass."""
+    _EXTRA_RULES[rule_id] = (tuple(severities), doc)
+
+
+def register_pass(rule_id, requires=(), severities=()):
     """Register ``fn(ctx) -> iterable[Finding]`` under ``rule_id``.
     ``requires`` names GraphContext fields that must be non-None for
-    the pass to run (it is silently skipped otherwise)."""
+    the pass to run (it is silently skipped otherwise); ``severities``
+    names the severity levels the pass can emit (catalog metadata for
+    ``--list-rules``)."""
 
     def deco(fn):
         _REGISTRY[rule_id] = GraphPass(
             rule_id=rule_id, fn=fn, requires=tuple(requires),
             doc=(fn.__doc__ or "").strip().split("\n")[0],
+            severities=tuple(severities),
         )
         return fn
 
@@ -131,11 +156,28 @@ def all_passes():
     return dict(_REGISTRY)
 
 
+def rule_catalog():
+    """The full rule surface: every registered graph pass plus the
+    non-graph rules (AST pickling contract, reshard pre-flight), as
+    ``rule_id -> (severities, one_liner)`` in registration order."""
+    _load_builtin_passes()
+    # Imported for their register_rule_info side effects.
+    from sparkdl_tpu.analysis import comms, selflint  # noqa: F401
+
+    out = {
+        rule_id: (p.severities, p.doc)
+        for rule_id, p in _REGISTRY.items()
+    }
+    out.update(_EXTRA_RULES)
+    return out
+
+
 def _load_builtin_passes():
     # Import for side effect of registration; lazy so `import
     # sparkdl_tpu.analysis` stays jax-free.
     from sparkdl_tpu.analysis import (  # noqa: F401
         passes_collectives,
+        passes_comms,
         passes_donation,
         passes_dtype,
         passes_host,

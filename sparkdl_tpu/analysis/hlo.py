@@ -22,10 +22,11 @@ COLLECTIVE_KINDS = (
 
 # `%x = f32[2,8]{1,0} all-gather(...)` or tuple-typed
 # `%x = (f32[8]{0}, u32[]) all-reduce(...)`; "-start" variants are the
-# async halves of the same op.
+# async halves of the same op (the matching "-done" lines carry no
+# payload type of their own and are deliberately not matched).
 _OP_RE = re.compile(
     r"=\s*(?P<rtype>\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
-    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\("
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<start>-start)?\("
 )
 _TYPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 # Explicit `{{0,1},{2,3}}` and iota `[2,4]<=[8]` (optionally
@@ -64,6 +65,7 @@ class HloCollective:
     index: int            # order of appearance in the module text
     line: str
     result_types: tuple   # ((dtype, shape), ...) for tuple-typed results
+    async_start: bool = False   # a "-start" half (async collective)
 
     @property
     def elements(self):
@@ -101,6 +103,7 @@ def collectives(hlo_text):
             index=len(out),
             line=line.strip(),
             result_types=rtypes,
+            async_start=m.group("start") is not None,
         ))
     return out
 
@@ -110,7 +113,7 @@ _IOTA_RE = re.compile(
 )
 
 
-def _groups_of(col):
+def groups_of(col):
     """replica_groups text -> list of device-id lists ([] = all)."""
     text = col.replica_groups
     m = _IOTA_RE.match(text)
@@ -150,14 +153,14 @@ def role_sequences(cols):
     seqs = {}
     device_ids = set()
     for col in cols:
-        for grp in _groups_of(col):
+        for grp in groups_of(col):
             device_ids.update(grp)
     if not device_ids:
         device_ids = {"*"}
     for dev in sorted(device_ids, key=str):
         seq = []
         for col in cols:
-            groups = _groups_of(col)
+            groups = groups_of(col)
             if not groups:
                 member = True
                 sig = "{}"

@@ -17,7 +17,8 @@ from sparkdl_tpu.analysis import jaxpr_walk
 from sparkdl_tpu.analysis.core import Finding, Severity, register_pass
 
 
-@register_pass("collective-consistency", requires=("jaxpr",))
+@register_pass("collective-consistency", requires=("jaxpr",),
+               severities=("ERROR", "WARNING"))
 def collective_consistency(ctx):
     """Flag control flow under which ranks could execute divergent
     collective sequences (gang deadlock)."""
@@ -143,7 +144,9 @@ def check_gang_consistency(jaxprs, names=None):
     return findings
 
 
-@register_pass("full-param-allgather", requires=("hlo_text", "param_info"))
+@register_pass("full-param-allgather",
+               requires=("hlo_text", "param_info"),
+               severities=("ERROR", "WARNING"))
 def full_param_allgather(ctx):
     """Flag all-gathers that materialize a fully-replicated copy of a
     TP-sharded parameter (generalizes the tests/test_graft_entry.py

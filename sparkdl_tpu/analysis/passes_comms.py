@@ -1,0 +1,367 @@
+"""Communication/memory budget passes: implicit reshards, HBM
+overcommit, and unhidden collectives.
+
+Three failure modes that compile cleanly and only hurt at scale:
+
+- the arrays a program is fed carry a *different* sharding than the
+  program was lowered for — XLA silently inserts a reshard (worst
+  case: a full-replication round trip of a parameter) on every call;
+- the compiled program's static peak HBM — or the param/optimizer
+  state re-laid onto a *target* mesh — exceeds the chip's capacity,
+  an OOM that a tiny dryrun never sees;
+- barrier-style collectives with no interleaved compute serialize the
+  step behind the interconnect; the statically-predicted hideable
+  seconds are the target list for the async-overlap work (the static
+  twin of the measured ``overlap_efficiency``).
+"""
+
+import re
+
+from sparkdl_tpu.analysis import comms as comms_mod
+from sparkdl_tpu.analysis import hlo as hlo_mod
+from sparkdl_tpu.analysis.core import Finding, Severity, register_pass
+from sparkdl_tpu.analysis.passes_donation import _main_signature
+
+# -- implicit-reshard --------------------------------------------------------
+
+_SHARDING_ATTR_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_DEVICES_RE = re.compile(r"devices=\[([0-9,]+)\]")
+_LAST_TILE_DIMS_RE = re.compile(r"last_tile_dims=\{([^}]*)\}")
+
+
+def parse_hlo_sharding(text):
+    """HloSharding text -> per-dim tile counts, or ``None`` when not
+    statically comparable (maximal/manual/tuple shardings, unknown
+    syntax — degrade to silence, never crash).
+
+    ``'{replicated}'`` -> ``()`` (every dim count 1);
+    ``'{devices=[2,1]<=[2]}'`` -> ``(2, 1)``;
+    ``'{devices=[2,1,2]<=[4] last_tile_dim_replicate}'`` -> ``(2, 1)``.
+    """
+    t = (text or "").strip()
+    if not t:
+        return None
+    if "maximal" in t or "manual" in t or t.startswith("{{"):
+        return None
+    if "devices=" not in t:
+        return () if "replicated" in t else None
+    m = _DEVICES_RE.search(t)
+    if m is None:
+        return None
+    dims = [int(x) for x in m.group(1).split(",") if x]
+    if "last_tile_dims=" in t:
+        m2 = _LAST_TILE_DIMS_RE.search(t)
+        n = len([x for x in m2.group(1).split(",") if x.strip()]) \
+            if m2 else 0
+        dims = dims[:len(dims) - n]
+    elif "last_tile_dim_replicate" in t:
+        dims = dims[:-1]
+    return tuple(dims)
+
+
+def entry_arg_shardings(stablehlo_text):
+    """``[(index, shape, dtype, tile_counts_or_None)]`` for the entry
+    computation's tensor arguments — the shardings the compiled
+    program *expects* its inputs to arrive in."""
+    from sparkdl_tpu.analysis.passes_donation import _MLIR_DTYPES
+
+    sig = _main_signature(stablehlo_text)
+    if sig is None:
+        return []
+    out = []
+    for chunk in re.split(r",\s*(?=%arg\d+\s*:)", sig):
+        m = re.match(r"\s*%arg(\d+)\s*:\s*tensor<([^>]*)>", chunk)
+        if m is None:
+            continue
+        dims = m.group(2).split("x")
+        dtype = _MLIR_DTYPES.get(dims[-1])
+        shape = None
+        if dtype is not None:
+            try:
+                shape = tuple(int(d) for d in dims[:-1])
+            except ValueError:
+                shape = None
+        sm = _SHARDING_ATTR_RE.search(chunk)
+        tiles = parse_hlo_sharding(sm.group(1)) if sm else None
+        out.append((int(m.group(1)), shape, dtype, tiles))
+    return out
+
+
+def _expected_tiles(info):
+    """Per-dim partition counts the ParamInfo's own sharding implies
+    (its spec axes sized by its mesh), or None without spec data."""
+    if not info.mesh_axes:
+        return None
+    axes = dict(info.mesh_axes)
+    return tuple(
+        comms_mod._dim_partitions(
+            info.spec[d] if d < len(info.spec) else (), axes)
+        for d in range(len(info.shape))
+    )
+
+
+def _norm_tiles(tiles, ndim):
+    """Pad/trim tile counts to ndim (trailing replication dims are
+    already stripped by the parser; missing dims count 1)."""
+    t = list(tiles or ())[:ndim]
+    return tuple(t + [1] * (ndim - len(t)))
+
+
+def _spec_str(info):
+    return "P(" + ", ".join(
+        ("/".join(entry) if entry else "None")
+        for entry in (info.spec or [()] * len(info.shape))
+    ) + ")"
+
+
+@register_pass("implicit-reshard",
+               requires=("stablehlo_text", "param_info"),
+               severities=("ERROR", "WARNING"))
+def implicit_reshard(ctx):
+    """Flag params whose producer sharding (the tree the arrays carry)
+    differs from the sharding the lowered program expects — XLA
+    inserts a silent reshard per call; a full-replication round trip
+    of a large param is an ERROR."""
+    args = entry_arg_shardings(ctx.stablehlo_text)
+    if not args:
+        return []
+    by_sig = {}
+    for info in ctx.param_info:
+        exp = _expected_tiles(info)
+        if exp is None:
+            continue
+        by_sig.setdefault((info.dtype, info.shape), []).append((info, exp))
+    if not by_sig:
+        return []
+    max_param_bytes = max(
+        comms_mod.param_nbytes(i) for i in ctx.param_info
+    )
+    findings = []
+    claimed = set()
+    for idx, shape, dtype, tiles in args:
+        if shape is None or tiles is None:
+            continue
+        cands = by_sig.get((dtype, shape))
+        if not cands:
+            continue
+        actual = _norm_tiles(tiles, len(shape))
+        # An arg matching ANY same-signature param's expected tiling
+        # is consistent with the tree and stays silent — even when
+        # that leaf was already matched: optimizer-state leaves (adam
+        # mu/nu) share every param's (dtype, shape) and arrive with
+        # the param's sharding, so signature matching cannot tell them
+        # apart and must not invent a reshard for the second arrival.
+        hit = next(
+            ((i, e) for i, e in cands
+             if _norm_tiles(e, len(shape)) == actual),
+            None,
+        )
+        if hit is not None:
+            claimed.add(hit[0].path)
+            continue
+        info, expected = next(
+            ((i, e) for i, e in cands if i.path not in claimed),
+            cands[0],
+        )
+        claimed.add(info.path)
+        expected = _norm_tiles(expected, len(shape))
+        full = comms_mod.param_nbytes(info)
+        replication_trip = (
+            max(actual) == 1 and max(expected) > 1
+        )
+        if replication_trip:
+            # The program wants the FULL (replicated) tensor while the
+            # producer holds shards: every call gathers the whole
+            # param in and (for carried state) scatters it back out.
+            bytes_moved = 2 * full
+            severity = (Severity.ERROR
+                        if bytes_moved > max_param_bytes
+                        else Severity.WARNING)
+            story = (
+                "a full-replication round trip "
+                f"(~{bytes_moved / 2**20:.1f} MiB/call)"
+            )
+        else:
+            bytes_moved = full
+            severity = Severity.WARNING
+            story = f"a reshard copy (~{bytes_moved / 2**20:.1f} MiB/call)"
+        findings.append(Finding(
+            rule_id="implicit-reshard",
+            severity=severity,
+            op=info.path,
+            location="",
+            message=(
+                f"%arg{idx} ({dtype}{list(shape)}, param {info.path}) "
+                f"arrives sharded {_spec_str(info)} = per-dim tiles "
+                f"{list(expected)}, but the program was lowered "
+                f"expecting tiles {list(actual)}: XLA inserts {story} "
+                "every step. Re-lower with in_shardings matching the "
+                "arrays (or device_put the arrays to the program's "
+                "sharding once, outside the step)."
+            ),
+        ))
+    return findings
+
+
+# -- hbm-overcommit ----------------------------------------------------------
+
+
+@register_pass("hbm-overcommit", requires=("memory_stats",),
+               severities=("ERROR", "WARNING"))
+def hbm_overcommit(ctx):
+    """Flag programs whose static peak HBM (compiled memory analysis,
+    plus param/optimizer state re-laid onto a target mesh when one is
+    given) overcommits the device's capacity."""
+    from sparkdl_tpu.observe import perf
+
+    stats = ctx.memory_stats
+    capacity = ctx.options.get("hbm_bytes_per_device")
+    if capacity is None:
+        capacity = perf.hbm_capacity_bytes(ctx.options.get("device_kind"))
+    if not capacity:
+        return []     # no chip budget to compare against (cpu rigs)
+    headroom = float(ctx.options.get("hbm_headroom_fraction", 0.9))
+    peak = (stats.get("argument_size_in_bytes", 0)
+            + stats.get("output_size_in_bytes", 0)
+            + stats.get("temp_size_in_bytes", 0)
+            - stats.get("alias_size_in_bytes", 0))
+    findings = []
+    frac = peak / capacity
+    if frac > 1.0:
+        severity, verb = Severity.ERROR, "exceeds"
+    elif frac > headroom:
+        severity, verb = Severity.WARNING, "crowds"
+    else:
+        severity = None
+    if severity is not None:
+        findings.append(Finding(
+            rule_id="hbm-overcommit",
+            severity=severity,
+            op="module",
+            location="",
+            message=(
+                f"static peak HBM {peak / 2**30:.2f} GiB (args + "
+                f"outputs + temps - aliased) {verb} the per-device "
+                f"budget {capacity / 2**30:.2f} GiB "
+                f"({frac:.0%}): this program "
+                + ("OOMs at launch." if frac > 1.0 else
+                   "leaves no headroom for fragmentation/infeed.")
+            ),
+        ))
+    # Target-mesh mode: the elastic question — does the state still
+    # fit after resharding to the target mesh? Rides the same
+    # reshard_plan the supervisor pre-flight uses.
+    target_axes = ctx.options.get("target_mesh_axes")
+    if target_axes and ctx.param_info:
+        source_axes = {}
+        for info in ctx.param_info:
+            source_axes.update(dict(info.mesh_axes))
+        plan = comms_mod.reshard_plan(
+            ctx.param_info, source_axes, dict(target_axes),
+            local_device_count=ctx.options.get("local_device_count"),
+            hbm_bytes=capacity,
+            state_multiplier=float(
+                ctx.options.get("state_multiplier", 3.0)),
+        )
+        findings.extend(plan.problems)
+    return findings
+
+
+# -- unoverlapped-collective -------------------------------------------------
+
+_COMPUTE_RE = re.compile(
+    r"=\s*\S+\s+(fusion|dot|convolution|while|custom-call|call)\("
+)
+_RESULT_VAR_RE = re.compile(r"^\s*(%?[\w.\-]+)\s*=")
+
+
+def _async_has_compute_between(lines, start_i, kind, var):
+    """True when compute ops sit between an async collective's -start
+    line and its matching -done (the overlap actually hides it)."""
+    done_pat = re.compile(
+        re.escape(kind) + r"-done\(.*" + re.escape(var) + r"[,)\s]"
+    )
+    saw_compute = False
+    for line in lines[start_i + 1:]:
+        if done_pat.search(line):
+            return saw_compute
+        if _COMPUTE_RE.search(line):
+            saw_compute = True
+    return saw_compute
+
+
+@register_pass("unoverlapped-collective", requires=("hlo_text",),
+               severities=("INFO",))
+def unoverlapped_collective(ctx):
+    """Report barrier-style collectives with no interleaved compute —
+    statically-predicted hideable seconds, the target list for
+    async-overlap work (the static twin of the measured
+    overlap_efficiency)."""
+    cols = hlo_mod.collectives(ctx.hlo_text)
+    if not cols:
+        return []
+    lines = ctx.hlo_text.splitlines()
+    line_index = {}
+    for i, line in enumerate(lines):
+        line_index.setdefault(line.strip(), i)
+    n_devices = ctx.options.get("n_devices")
+    device_kind = ctx.options.get("device_kind")
+    unhidden = []
+    for col in cols:
+        if col.async_start:
+            i = line_index.get(col.line)
+            m = _RESULT_VAR_RE.match(col.line)
+            if i is not None and m and _async_has_compute_between(
+                    lines, i, col.kind, m.group(1)):
+                continue   # genuinely overlapped: stays silent
+        unhidden.append(col)
+    if not unhidden:
+        return []
+    from sparkdl_tpu.observe import perf
+
+    kind_key = device_kind or perf.device_kind() or "cpu"
+    ici = perf.peak_interconnect_bytes_per_sec(kind_key)
+    # Aggregate per op signature: a scan-unrolled ring emits dozens of
+    # identical permutes — one finding each would drown the report.
+    groups = {}
+    for col in unhidden:
+        sig = (col.kind, col.dtype, col.shape, col.async_start)
+        groups.setdefault(sig, []).append(col)
+    findings = []
+    total_s = 0.0
+    for (kind, dtype, shape, was_async), members in groups.items():
+        n = comms_mod.group_size_of(members[0], n_devices=n_devices)
+        wire = comms_mod.collective_wire_bytes(
+            kind, comms_mod._result_bytes(members[0]), n)
+        secs = len(members) * (wire / ici if ici else 0.0)
+        total_s += secs
+        shape_s = f"{dtype}{list(shape)}"
+        findings.append(Finding(
+            rule_id="unoverlapped-collective",
+            severity=Severity.INFO,
+            op=kind,
+            location="",
+            message=(
+                f"{len(members)}x {kind} {shape_s}"
+                + (f" (group size {n})" if n else "")
+                + (" issued async but with no compute between start "
+                   "and done" if was_async else
+                   " is barrier-style (sync)")
+                + f": ~{len(members) * wire / 2**20:.2f} MiB on the "
+                  f"wire, ~{secs * 1e3:.2f} ms predicted hideable "
+                  "under compute via async start/done."
+            ),
+        ))
+    findings.insert(0, Finding(
+        rule_id="unoverlapped-collective",
+        severity=Severity.INFO,
+        op="module",
+        location="",
+        message=(
+            f"{len(unhidden)} of {len(cols)} collective(s) have no "
+            f"compute to hide under — ~{total_s * 1e3:.2f} ms/step "
+            f"predicted hideable on {kind_key} "
+            f"(ici={ici:.2e} B/s, ring assumption)."
+        ),
+    ))
+    return findings

@@ -214,7 +214,8 @@ def _nbytes(shape, dtype):
     return n
 
 
-@register_pass(_RULE, requires=("stablehlo_text",))
+@register_pass(_RULE, requires=("stablehlo_text",),
+               severities=("WARNING", "INFO"))
 def undonated_step_buffers(ctx):
     """Flag params/opt_state-sized step inputs that are not donated
     (peak HBM holds old + new copies of everything undonated)."""

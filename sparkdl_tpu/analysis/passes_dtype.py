@@ -62,7 +62,8 @@ def payload_findings(tree, where="payload"):
     return findings
 
 
-@register_pass(_RULE, requires=("example_args",))
+@register_pass(_RULE, requires=("example_args",),
+               severities=("ERROR", "WARNING"))
 def silent_canonicalization(ctx):
     """Flag 64-bit inputs and in-graph 64-bit constants that
     canonicalize to 32 bits with x64 off."""

@@ -21,7 +21,7 @@ from sparkdl_tpu.analysis.core import Finding, Severity, register_pass
 _RULE = "host-sync-in-step"
 
 
-@register_pass(_RULE)  # requires jaxpr OR hlo_text: checked inline
+@register_pass(_RULE, severities=("ERROR", "WARNING"))  # requires jaxpr OR hlo_text: checked inline
 def host_sync_in_step(ctx):
     """Flag device↔host transfers, callbacks, and Python-scalar
     weak-type leaks inside the jitted step."""

@@ -36,6 +36,18 @@ logger = logging.getLogger("HorovodRunner")
 
 _REGISTERED = []
 
+# Comms reports priced during the newest preflight_lint run — the
+# launcher collects these (take_comms_reports) into the gang telemetry
+# run dir so observe.doctor can render predicted next to measured.
+_COMMS_REPORTS = []
+
+
+def take_comms_reports():
+    """Drain the comms reports the last pre-flight produced."""
+    out = list(_COMMS_REPORTS)
+    _COMMS_REPORTS.clear()
+    return out
+
 
 class PreflightLintError(RuntimeError):
     """ERROR-severity findings in the pre-flight lint; the gang was
@@ -163,9 +175,19 @@ def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
     rank-private payload list) gets the same payload checks as
     ``kwargs`` — a 64-bit leaf shipped to one rank canonicalizes just
     as silently as one shipped to all of them."""
+    # Cleared unconditionally (even disabled / about-to-raise): the
+    # launcher drains this list after EVERY preflight_lint call, and a
+    # stale report from a refused or lint-on launch must never
+    # describe a later lint-off launch's run dir.
+    _COMMS_REPORTS.clear()
     if not enabled(environ):
         return None
-    from sparkdl_tpu.analysis import lint_compiled, lint_fn, lint_lowered
+    from sparkdl_tpu.analysis import (
+        _compiled_context,
+        _context_for,
+        _lowered_context,
+        run_passes,
+    )
     from sparkdl_tpu.analysis.core import Severity
     from sparkdl_tpu.analysis.passes_dtype import payload_findings
 
@@ -178,12 +200,39 @@ def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
     findings.extend(_closure_findings(main))
     for obj, args, opts in list(_REGISTERED):
         try:
+            # ``passes=`` restricts which passes run (the old
+            # lint_lowered/lint_compiled/lint_fn contract); the
+            # context builders don't take it.
+            opts = dict(opts)
+            passes = opts.pop("passes", None)
             if hasattr(obj, "compile"):          # Lowered
-                findings.extend(lint_lowered(obj, **opts))
+                ctx = _lowered_context(obj, **opts)
             elif hasattr(obj, "as_text") or hasattr(obj, "runtime_executable"):
-                findings.extend(lint_compiled(obj, **opts))
+                ctx = _compiled_context(obj, **opts)
             elif callable(obj):
-                findings.extend(lint_fn(obj, *args, **opts))
+                ctx = _context_for(obj, args, **opts)
+            else:
+                continue
+            findings.extend(run_passes(ctx, passes=passes))
+            if ctx.hlo_text is not None:
+                # The same compiled module the passes just audited,
+                # priced: per-collective bytes-on-the-wire + predicted
+                # seconds. Logged here; the launcher ships it into the
+                # gang telemetry run dir (comms_report.json) so the
+                # doctor can set predicted against measured.
+                from sparkdl_tpu.analysis.comms import comms_report
+
+                report = comms_report(ctx.hlo_text, name=ctx.fn_name)
+                _COMMS_REPORTS.append(report)
+                t = report["totals"]
+                logger.info(
+                    "pre-flight comms budget [%s]: %d collective(s), "
+                    "%.2f MiB/device on the wire, ~%.3f ms/step "
+                    "predicted (%s, ring assumption)",
+                    ctx.fn_name, t["count"],
+                    t["wire_bytes_per_device"] / 2**20,
+                    t["predicted_s"] * 1e3, report["device_kind"],
+                )
         except Exception as e:
             logger.warning(
                 "pre-flight lint could not analyze %r (%s: %s); "
@@ -195,7 +244,9 @@ def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
             logger.warning("pre-flight lint: %s", f)
     if errors:
         # Full list, not just the errors — the warnings are context
-        # for whoever reads the exception.
+        # for whoever reads the exception. The priced budgets die with
+        # the refusal: no gang, no run dir, nothing to drain them.
+        _COMMS_REPORTS.clear()
         raise PreflightLintError(findings)
     if findings:
         logger.info(

@@ -23,9 +23,26 @@ the launcher is about to pickle.
 import ast
 from pathlib import Path
 
-from sparkdl_tpu.analysis.core import Finding, Severity
+from sparkdl_tpu.analysis.core import (
+    Finding,
+    Severity,
+    register_rule_info,
+)
 
 RULE_ID = "pickle-closure-capture"
+
+# Intentional captures (docs snippets, single-process examples) are
+# suppressed with this comment on the module-level assignment OR on
+# the capturing load line — the in-source twin of a lint allowlist,
+# so examples stop needing test-side exemptions.
+ALLOW_COMMENT = "# sparkdl: allow-capture"
+
+register_rule_info(
+    RULE_ID, ("ERROR",),
+    "Pickling contract for HorovodRunner.run mains: no captured Spark "
+    "handles or module-level device arrays (suppress intentional ones "
+    f"with `{ALLOW_COMMENT}`).",
+)
 
 _SPARK_NAMES = {"SparkContext", "SparkSession"}
 # Module-level calls whose result is a device-resident jax array.
@@ -198,6 +215,15 @@ def lint_source(text, filename="<source>"):
         n.name: n for n in tree.body
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
     }
+    src_lines = text.splitlines()
+
+    def _suppressed(*linenos):
+        return any(
+            0 < ln <= len(src_lines)
+            and ALLOW_COMMENT in src_lines[ln - 1]
+            for ln in linenos
+        )
+
     findings = []
     for main_name, _ in mains:
         func = funcs.get(main_name)
@@ -208,6 +234,8 @@ def lint_source(text, filename="<source>"):
             if hit is None:
                 continue
             kind, detail, def_line = hit
+            if _suppressed(def_line, line):
+                continue
             what = (
                 f"the module-level Spark handle {name!r} ({detail}, "
                 f"line {def_line}): SparkContext/SparkSession are not "

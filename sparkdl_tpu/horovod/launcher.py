@@ -460,9 +460,18 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     # supervisor loop, slot claims, payload serialization, or any
     # worker spawn. A graph bug is permanent; retrying it under
     # backoff would burn the whole retry budget on chip-hours.
-    from sparkdl_tpu.analysis.preflight import preflight_lint
+    from sparkdl_tpu.analysis.preflight import (
+        preflight_lint,
+        take_comms_reports,
+    )
 
     preflight_lint(main, kwargs, per_rank_kwargs=per_rank_kwargs)
+    # The pre-flight also priced every registered compiled module's
+    # collectives (the static comms budget). Collected here so the
+    # telemetry run dir carries comms_report.json next to the measured
+    # collective_bytes_total — observe.doctor renders the two side by
+    # side (predicted-vs-measured is the analyzer's own e2e gate).
+    comms_reports = take_comms_reports()
 
     # Opt-in telemetry (SPARKDL_TPU_TELEMETRY_DIR): ONE aggregator per
     # launch_gang call spans every supervised attempt, so a chaos run's
@@ -474,6 +483,8 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
         from sparkdl_tpu.observe.aggregate import GangTelemetry
 
         telemetry = GangTelemetry()
+        if comms_reports:
+            telemetry.add_comms_reports(comms_reports)
     try:
         return supervise(
             lambda extra_env: _launch_gang_once(

@@ -45,6 +45,21 @@ untouched; see ``docs/fault_tolerance.rst``):
   signatures (case-insensitive substring match against worker
   tracebacks) an operator can add for an interconnect whose
   infrastructure errors this module does not know yet.
+- ``SPARKDL_TPU_GANG_RELAUNCH_NP``: target world size for the next
+  relaunch (the elastic-shrink knob — a preempted pod coming back
+  smaller). Before any relaunch with this set, the supervisor runs
+  the static reshard pre-flight
+  (:func:`sparkdl_tpu.analysis.comms.check_relaunch_np`) against the
+  sharding tree the driver registered via
+  :func:`sparkdl_tpu.analysis.register_gang_sharding`: an infeasible
+  target — indivisible param dim, fractional-host mesh, restore
+  high-water over the HBM budget — raises a typed
+  :class:`~sparkdl_tpu.analysis.comms.ReshardPreflightError` naming
+  the failing param/axis *before* the backoff sleep, instead of an
+  OOM (or a sharding crash) mid-restore on the chips. Feasible
+  targets are shipped to the relaunched workers through the same env
+  var. With no registered tree the relaunch proceeds unchecked
+  (nothing provable).
 - ``SPARKDL_TPU_COMPILE_CACHE_DIR`` (read by the launcher/worker, not
   here, but load-bearing for this loop): the warm-start compile cache
   (:mod:`sparkdl_tpu.parallel.compile`). It rides the inherited
@@ -71,6 +86,11 @@ BACKOFF_MAX_ENV = "SPARKDL_TPU_GANG_BACKOFF_MAX"
 BACKOFF_JITTER_ENV = "SPARKDL_TPU_GANG_BACKOFF_JITTER"
 RESUME_DIR_ENV = "SPARKDL_TPU_GANG_RESUME_DIR"
 EXTRA_PATTERNS_ENV = "SPARKDL_TPU_TRANSIENT_PATTERNS"
+# Elastic-relaunch target np. Same literal as
+# sparkdl_tpu.analysis.comms.RELAUNCH_NP_ENV (kept as a plain string
+# here so this module never imports the analysis package at import
+# time); tests pin the two spellings together.
+RELAUNCH_NP_ENV = "SPARKDL_TPU_GANG_RELAUNCH_NP"
 
 # The restart context workers read back via
 # sparkdl_tpu.horovod.restart_context(). Shipped per-attempt through
@@ -328,6 +348,65 @@ def _resume_step(policy):
     return latest_complete_step(policy.resume_dir)
 
 
+def _relaunch_np_target():
+    """The operator's elastic-relaunch target np, or None (unset or
+    unparsable — the latter is logged, never fatal: a typo must not
+    take down an otherwise-recoverable gang)."""
+    raw = os.environ.get(RELAUNCH_NP_ENV)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning(
+            "ignoring unparsable %s=%r (want an integer np)",
+            RELAUNCH_NP_ENV, raw,
+        )
+        return None
+
+
+def _reshard_preflight(target_np):
+    """Feasibility-gate an elastic relaunch at ``target_np`` BEFORE the
+    backoff sleep: an infeasible shrink raises the typed
+    ``ReshardPreflightError`` (naming the failing param/axis) here on
+    the driver, where it costs a log line — not mid-restore on the
+    chips, where it costs the pod an OOM. Returns the ReshardPlan, or
+    None when no sharding tree was registered (nothing provable; the
+    relaunch proceeds unchecked)."""
+    from sparkdl_tpu import observe
+    from sparkdl_tpu.analysis.comms import (
+        ReshardPreflightError,
+        check_relaunch_np,
+    )
+
+    try:
+        plan = check_relaunch_np(target_np)
+    except ReshardPreflightError as e:
+        observe.instant(
+            "gang.reshard_refused", cat="supervisor",
+            target_np=target_np,
+            problems=[str(f) for f in e.findings[:4]],
+        )
+        logger.error(
+            "elastic relaunch at np=%d refused by the reshard "
+            "pre-flight; not relaunching: %s", target_np, e,
+        )
+        raise
+    if plan is not None:
+        observe.instant(
+            "gang.reshard_preflight", cat="supervisor",
+            target_np=target_np, feasible=True,
+            restore_high_water_bytes=plan.restore_high_water_bytes,
+        )
+        logger.info(
+            "elastic relaunch at np=%d cleared the reshard pre-flight "
+            "(target mesh %s, restore high-water %.2f GiB)",
+            target_np, plan.target_axes,
+            plan.restore_high_water_bytes / 2**30,
+        )
+    return plan
+
+
 def supervise(launch, policy, _sleep=time.sleep):
     """Run ``launch(extra_env)`` under the retry policy.
 
@@ -349,6 +428,13 @@ def supervise(launch, policy, _sleep=time.sleep):
             step = _resume_step(policy)
             if step is not None:
                 extra_env[RESUME_STEP_ENV] = str(step)
+            target_np = _relaunch_np_target()
+            if target_np is not None:
+                # Cleared by _reshard_preflight before the backoff
+                # that led here; shipped so the relaunched workers see
+                # the elastic target (the launcher honoring it
+                # end-to-end is the elastic-gang arc).
+                extra_env[RELAUNCH_NP_ENV] = str(target_np)
         observe.inc("gang_attempts_total")
         observe.instant("gang.attempt", cat="supervisor", attempt=attempt)
         try:
@@ -380,6 +466,12 @@ def supervise(launch, policy, _sleep=time.sleep):
                         attempts, policy.max_retries
                     ) from e
                 raise  # supervision off: surface the failure untouched
+            target_np = _relaunch_np_target()
+            if target_np is not None:
+                # Elastic relaunch: feasibility-check the shrunken
+                # mesh BEFORE paying the backoff sleep — an
+                # infeasible target raises the typed refusal here.
+                _reshard_preflight(target_np)
             delay = policy.backoff(attempt)
             # Recomputed at the top of the next iteration too (listdir
             # is cheap); shown here so the operator sees the resume

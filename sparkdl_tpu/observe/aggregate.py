@@ -42,6 +42,7 @@ PROM_FILE = "metrics.prom"
 JSON_FILE = "metrics.json"
 HEALTH_FILE = "health.json"
 PERF_FILE = "perf.json"
+COMMS_FILE = "comms_report.json"
 
 # perf.json keeps the newest per-step attribution rows up to this cap
 # (the aggregate components cover the whole run either way) so a
@@ -64,6 +65,7 @@ class GangTelemetry:
         self._stack_dumps = {}      # rank -> [(reason, dump), ...]
         self._job_dirs = []         # one per attempt (flight-rec scan)
         self._health_summaries = [] # one HangDetector summary/attempt
+        self._comms_reports = []    # static comms budgets (pre-flight)
         # The driver's global registry outlives launches (a notebook
         # driver runs many); baseline it NOW so write() reports only
         # THIS launch's driver-side movement. Worker snapshots need no
@@ -113,6 +115,17 @@ class GangTelemetry:
         if summary:
             with self._lock:
                 self._health_summaries.append(summary)
+
+    def add_comms_reports(self, reports):
+        """Static comms budgets the launcher pre-flight priced
+        (:func:`sparkdl_tpu.analysis.comms.comms_report`) — written to
+        ``comms_report.json`` so ``observe.doctor`` can set predicted
+        bytes-on-the-wire against the measured
+        ``collective_bytes_total`` counters."""
+        with self._lock:
+            self._comms_reports.extend(
+                r for r in reports if isinstance(r, dict)
+            )
 
     @staticmethod
     def _validate_snapshot(snap):
@@ -233,6 +246,10 @@ class GangTelemetry:
             dumps = {r: list(d) for r, d in self._stack_dumps.items()}
             job_dirs = list(self._job_dirs)
             health = list(self._health_summaries)
+            comms = list(self._comms_reports)
+        if comms:
+            files.append((COMMS_FILE, json.dumps(
+                {"reports": comms}, indent=2)))
         # Stack dumps from hang diagnosis: one text file per rank (a
         # rank dumped more than once — e.g. stall then hang — keeps
         # every dump, separated).

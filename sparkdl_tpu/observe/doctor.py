@@ -144,6 +144,56 @@ def _diagnose_perf(run_dir, events, by_rank):
     return out or None
 
 
+def _diagnose_comms(run_dir, by_rank):
+    """Predicted-vs-measured communication (or None): the static comms
+    budget the launcher pre-flight priced (``comms_report.json``, per
+    step under a ring assumption) set against the runtime
+    ``collective_bytes_total`` counters each rank actually moved —
+    with a measured-per-step/predicted ratio when the rank's executed
+    step count is recoverable from ``train_step_total``."""
+    doc = _load_json(os.path.join(run_dir, "comms_report.json"))
+    reports = [r for r in (doc or {}).get("reports", ())
+               if isinstance(r, dict)]
+    measured = {}
+    for rank_label, series in by_rank.items():
+        if not rank_label.isdigit():
+            continue
+        by_op = {}
+        steps = None
+        for (name, labels), v in series.get("counters", {}).items():
+            if name == "collective_bytes_total":
+                by_op[dict(labels).get("op", "?")] = int(v)
+            elif (name == "train_step_total"
+                  and dict(labels).get("phase") == "execute"):
+                steps = int(v)
+        if by_op:
+            measured[rank_label] = {
+                "bytes_by_op": by_op,
+                "bytes_total": sum(by_op.values()),
+                "steps": steps,
+            }
+    if not reports and not measured:
+        return None
+    predicted = sum(
+        r.get("totals", {}).get("wire_bytes_per_device") or 0
+        for r in reports
+    )
+    for entry in measured.values():
+        if predicted and entry["steps"]:
+            entry["per_step_vs_predicted"] = round(
+                (entry["bytes_total"] / entry["steps"]) / predicted, 3)
+    return {
+        "reports": [
+            {"name": r.get("name"),
+             "device_kind": r.get("device_kind"),
+             "totals": r.get("totals")}
+            for r in reports
+        ],
+        "predicted_wire_bytes_per_device_per_step": predicted or None,
+        "measured_by_rank": measured,
+    }
+
+
 def _diagnose_serving(events, by_rank, top_n=5):
     """Serving-run section (or None for pure gang dirs): slowest
     requests by TTFT, the admission rejection/deferral breakdown, and
@@ -330,6 +380,7 @@ def diagnose(run_dir):
         "flight_recorder_recovered_events": len(ring_fresh),
         "serving": _diagnose_serving(events, by_rank),
         "perf": _diagnose_perf(run_dir, events, by_rank),
+        "comms": _diagnose_comms(run_dir, by_rank),
         "hang": verdict is not None,
         "verdict": verdict,
         "stalled_ranks": sorted(stalled),
@@ -418,6 +469,35 @@ def render_text(diag):
             wait = p.get("inter_step_data_wait_s")
             if isinstance(wait, (int, float)) and wait > 0.0005:
                 line += f"; +{wait:.3f}s data wait between steps"
+            lines.append(line)
+    comms = diag.get("comms")
+    if comms:
+        pred = comms.get("predicted_wire_bytes_per_device_per_step")
+        for rep in comms.get("reports", ()):
+            t = rep.get("totals") or {}
+            lines.append(
+                f"static comms budget [{rep.get('name')}]: "
+                f"{t.get('count')} collective(s), "
+                f"{_fmt_bytes(t.get('wire_bytes_per_device'))}/device"
+                "/step predicted on the wire "
+                f"(~{(t.get('predicted_s') or 0) * 1e3:.3f} ms, ring, "
+                f"{rep.get('device_kind')})")
+        for rank_s, m in sorted(comms.get("measured_by_rank",
+                                          {}).items()):
+            line = (f"  measured rank {rank_s}: "
+                    f"{_fmt_bytes(m.get('bytes_total'))} via "
+                    + ", ".join(f"{op} {_fmt_bytes(b)}"
+                                for op, b in
+                                sorted(m.get("bytes_by_op",
+                                             {}).items())))
+            if m.get("steps"):
+                line += f" over {m['steps']} step(s)"
+            ratio = m.get("per_step_vs_predicted")
+            if ratio is not None:
+                line += f"; {ratio:.2f}x the predicted budget/step"
+            elif pred is None:
+                line += (" (no static budget to compare — the "
+                         "pre-flight prices registered steps only)")
             lines.append(line)
     srv = diag.get("serving")
     if srv:

@@ -63,6 +63,8 @@ import time
 
 PEAK_FLOPS_ENV = "SPARKDL_TPU_PEAK_FLOPS"
 PEAK_BYTES_ENV = "SPARKDL_TPU_PEAK_BYTES_PER_S"
+PEAK_ICI_ENV = "SPARKDL_TPU_PEAK_ICI_BYTES_PER_S"
+HBM_BYTES_ENV = "SPARKDL_TPU_HBM_BYTES"
 HISTORY_ENV = "SPARKDL_TPU_PERF_HISTORY"
 
 BREAKDOWN_SCHEMA = "sparkdl_tpu.perf.breakdown/1"
@@ -82,20 +84,37 @@ _CAT_TO_COMPONENT = {
     "checkpoint": "checkpoint",
 }
 
-# Dense bf16 peak FLOPs/s and HBM bytes/s per chip, keyed by the
-# normalized device kind (public TPU specs). The ``cpu`` entry is a
-# nominal proxy constant — a deviceless dev container has no honest
-# peak, but the CPU-proxy trajectory still wants a stable denominator
-# so its MFU-shaped gauge moves only when the code does. Override
-# either axis with SPARKDL_TPU_PEAK_FLOPS / SPARKDL_TPU_PEAK_BYTES_PER_S.
+# Dense bf16 peak FLOPs/s, HBM bytes/s, and aggregate ICI
+# (inter-chip interconnect) bytes/s per chip, keyed by the normalized
+# device kind (public TPU specs; ICI row = total off-chip link
+# bandwidth per chip, the denominator the static comms budget divides
+# wire bytes by). The ``cpu`` entry is a nominal proxy constant — a
+# deviceless dev container has no honest peak, but the CPU-proxy
+# trajectory still wants a stable denominator so its MFU-shaped gauge
+# moves only when the code does. Override any axis with
+# SPARKDL_TPU_PEAK_FLOPS / SPARKDL_TPU_PEAK_BYTES_PER_S /
+# SPARKDL_TPU_PEAK_ICI_BYTES_PER_S.
 PEAK_TABLE = {
-    "v4": (275e12, 1.23e12),
-    "v5e": (197e12, 0.82e12),
-    "v5p": (459e12, 2.77e12),
-    # Nominal many-core AVX f32 peak + DDR bandwidth: generous enough
-    # that no real CPU measurement crosses 1.0, stable enough that the
-    # proxy MFU only moves when the code does.
-    "cpu": (1e12, 2e11),
+    "v4": (275e12, 1.23e12, 3.0e11),    # 2400 Gbps ICI
+    "v5e": (197e12, 0.82e12, 2.0e11),   # 1600 Gbps ICI
+    "v5p": (459e12, 2.77e12, 6.0e11),   # 4800 Gbps ICI
+    # Nominal many-core AVX f32 peak + DDR bandwidth + a loopback/
+    # shared-memory "interconnect" proxy: generous enough that no real
+    # CPU measurement crosses 1.0, stable enough that the proxy MFU
+    # only moves when the code does.
+    "cpu": (1e12, 2e11, 1e10),
+}
+
+# Per-chip HBM capacity in bytes (public TPU specs) — the denominator
+# the hbm-overcommit analysis pass and the reshard-feasibility
+# pre-flight compare static peak estimates against. ``cpu`` is None:
+# host RAM is not a chip budget, so capacity checks are skipped there
+# unless SPARKDL_TPU_HBM_BYTES pins one explicitly.
+HBM_BYTES = {
+    "v4": 32 * 2**30,
+    "v5e": 16 * 2**30,
+    "v5p": 95 * 2**30,
+    "cpu": None,
 }
 
 # Unknown accelerator kinds fall back to the v5e figure — the constant
@@ -151,6 +170,29 @@ def peak_bytes_per_sec(kind=None):
     if env:
         return float(env)
     return PEAK_TABLE[normalize_device_kind(kind or device_kind())][1]
+
+
+def peak_interconnect_bytes_per_sec(kind=None):
+    """Aggregate per-chip ICI bytes/s for ``kind`` — the denominator
+    the static comms budget (:mod:`sparkdl_tpu.analysis.comms`) turns
+    wire bytes into predicted seconds with. Env-overridable via
+    ``SPARKDL_TPU_PEAK_ICI_BYTES_PER_S``."""
+    env = os.environ.get(PEAK_ICI_ENV)
+    if env:
+        return float(env)
+    return PEAK_TABLE[normalize_device_kind(kind or device_kind())][2]
+
+
+def hbm_capacity_bytes(kind=None):
+    """Per-chip HBM capacity in bytes for ``kind``, or ``None`` when
+    the kind has no chip budget (cpu). ``SPARKDL_TPU_HBM_BYTES``
+    overrides any kind — the knob an operator with a nonstandard
+    memory config (or a cpu rig that wants the overcommit pass live)
+    pins."""
+    env = os.environ.get(HBM_BYTES_ENV)
+    if env:
+        return float(env)
+    return HBM_BYTES[normalize_device_kind(kind or device_kind())]
 
 
 # -- step-time attribution ---------------------------------------------------

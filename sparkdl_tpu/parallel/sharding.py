@@ -82,6 +82,21 @@ def tp_param_info(params, shardings):
     return param_info_from(params, shardings)
 
 
+def sharding_tree_info(params, shardings):
+    """The sharding tree **as data**: one
+    :class:`~sparkdl_tpu.analysis.ParamInfo` per leaf carrying the full
+    shape/dtype, the per-dim mesh-axis spec (``.spec``) and the mesh
+    axis sizes the sharding was built against (``.mesh_axes``) — no
+    live jax sharding objects, so the result pickles, diffs, and can
+    be re-laid onto any *target* mesh. This is the input
+    :func:`sparkdl_tpu.analysis.comms.reshard_plan` (the elastic
+    pre-flight), the ``implicit-reshard`` pass, and the target-mesh
+    mode of ``hbm-overcommit`` consume."""
+    from sparkdl_tpu.analysis import param_info_from
+
+    return param_info_from(params, shardings)
+
+
 # Megatron-style rules for the transformer models in
 # sparkdl_tpu.models: column-parallel up-projections, row-parallel
 # down-projections, replicated norms.

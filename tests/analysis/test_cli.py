@@ -68,3 +68,40 @@ def test_list_passes(capsys):
     for rule in ("collective-consistency", "full-param-allgather",
                  "silent-canonicalization", "host-sync-in-step"):
         assert rule in out
+
+
+def test_list_rules_covers_the_full_catalog(capsys):
+    """--list-rules is the FULL rule surface: every graph pass plus
+    the non-graph rules (AST pickling contract, reshard pre-flight),
+    each with its severity set and one-liner."""
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("collective-consistency", "full-param-allgather",
+                 "silent-canonicalization", "host-sync-in-step",
+                 "undonated-step-buffers", "implicit-reshard",
+                 "hbm-overcommit", "unoverlapped-collective",
+                 "pickle-closure-capture", "reshard-infeasible"):
+        assert rule in out, f"{rule} missing from --list-rules"
+    # severities ride along (catalog metadata, not just ids)
+    assert "ERROR" in out and "INFO" in out
+
+
+def test_docs_catalog_never_drifts():
+    """Every registered rule id appears in docs/analysis.rst — a new
+    pass cannot land undocumented (the drift gate the ISSUE asks
+    for)."""
+    from pathlib import Path
+
+    from sparkdl_tpu.analysis.core import rule_catalog
+
+    docs = (Path(__file__).resolve().parents[2]
+            / "docs" / "analysis.rst").read_text()
+    missing = [rule for rule in rule_catalog() if rule not in docs]
+    assert not missing, (
+        f"rules missing from docs/analysis.rst: {missing}")
+
+
+def test_comms_requires_graft():
+    with pytest.raises(SystemExit) as e:
+        main(["--comms", "--self"])
+    assert e.value.code == 2

@@ -1,0 +1,565 @@
+"""Static comms budget + reshard feasibility: the wire-bytes cost
+model on synthetic HLO, the reshard_plan checker over shrink / grow /
+indivisible meshes, and the supervisor's elastic-relaunch refusal
+path (the acceptance unit test: infeasible np → typed error naming
+the failing param/axis, feasible shrink → relaunch proceeds). Mostly
+tier-1 (no jax, no gang); the predicted-vs-measured cross-check at
+the bottom spawns a real 2-rank gang (``gang`` marker)."""
+
+import json
+import os
+
+import pytest
+
+from sparkdl_tpu.analysis import comms
+from sparkdl_tpu.analysis.comms import (
+    ReshardPreflightError,
+    check_relaunch_np,
+    collective_wire_bytes,
+    comms_report,
+    register_gang_sharding,
+    reshard_plan,
+    shrink_mesh,
+    write_report,
+)
+from sparkdl_tpu.analysis.core import ParamInfo, Severity
+
+MiB = 2**20
+
+
+@pytest.fixture(autouse=True)
+def _clean_gang_sharding():
+    comms.clear_gang_sharding()
+    yield
+    comms.clear_gang_sharding()
+
+
+def _info(path="['w']", shape=(16, 64), dtype="float32",
+          spec=((), ("model",)), mesh_axes=(("data", 2), ("model", 4))):
+    sharded = tuple(a for entry in spec for a in entry)
+    return ParamInfo(path=path, shape=shape, dtype=dtype,
+                     sharded_axes=sharded, spec=spec,
+                     mesh_axes=mesh_axes)
+
+
+# ---------------------------------------------------------------------------
+# wire-bytes cost model
+# ---------------------------------------------------------------------------
+
+
+class TestWireBytes:
+    def test_all_reduce_two_passes(self):
+        # ring all-reduce = reduce-scatter + all-gather:
+        # 2 * (n-1)/n * payload
+        assert collective_wire_bytes("all-reduce", 1024, 4) == \
+            2 * 3 / 4 * 1024
+
+    def test_all_gather_receives_other_shards(self):
+        # result is the FULL tensor; each device already holds 1/n
+        assert collective_wire_bytes("all-gather", 1024, 4) == \
+            3 / 4 * 1024
+
+    def test_reduce_scatter_ships_other_shards(self):
+        # result is ONE shard; the input was n of them
+        assert collective_wire_bytes("reduce-scatter", 256, 4) == 3 * 256
+
+    def test_all_to_all_keeps_one_slice(self):
+        assert collective_wire_bytes("all-to-all", 1024, 8) == \
+            7 / 8 * 1024
+
+    def test_permute_is_one_copy(self):
+        assert collective_wire_bytes("collective-permute", 512, 8) == 512
+
+    def test_permute_with_unknown_group_still_one_copy(self):
+        """A permute's cost does not depend on the group size, so an
+        unknown device count (the pre-flight path) must not zero it."""
+        assert collective_wire_bytes(
+            "collective-permute", 512, None) == 512
+
+    def test_group_of_one_or_unknown_moves_nothing(self):
+        assert collective_wire_bytes("all-reduce", 1024, 1) == 0.0
+        assert collective_wire_bytes("all-reduce", 1024, None) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# comms_report over synthetic HLO
+# ---------------------------------------------------------------------------
+
+HLO_MIXED = """
+HloModule step
+ENTRY %main {
+  %p0 = f32[1024]{0} parameter(0)
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  %ag = f32[4096]{0} all-gather(f32[1024]{0} %ar), replica_groups=[1,4]<=[4], dimensions={0}
+  %rs = f32[256]{0} reduce-scatter(f32[1024]{0} %ag), replica_groups={{0,1,2,3}}, to_apply=%add
+  %cp = f32[1024]{0} collective-permute(f32[1024]{0} %rs), source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+}
+"""
+
+
+class TestCommsReport:
+    def test_every_collective_priced_nonzero(self):
+        rep = comms_report(HLO_MIXED, n_devices=4, device_kind="cpu",
+                           name="mixed")
+        assert rep["schema"] == comms.COMMS_SCHEMA
+        assert rep["totals"]["count"] == 4
+        kinds = [e["kind"] for e in rep["collectives"]]
+        assert kinds == ["all-reduce", "all-gather", "reduce-scatter",
+                         "collective-permute"]
+        for e in rep["collectives"]:
+            assert e["wire_bytes_per_device"] > 0, e
+            assert e["predicted_s"] > 0, e
+
+    def test_ring_arithmetic_per_kind(self):
+        rep = comms_report(HLO_MIXED, n_devices=4, device_kind="cpu")
+        by_kind = {e["kind"]: e for e in rep["collectives"]}
+        # all-reduce result f32[1024] = 4096 B, n=4
+        assert by_kind["all-reduce"]["wire_bytes_per_device"] == \
+            2 * 3 / 4 * 4096
+        # all-gather result f32[4096] = 16384 B (the FULL tensor)
+        assert by_kind["all-gather"]["wire_bytes_per_device"] == \
+            3 / 4 * 16384
+        # reduce-scatter result f32[256] = 1024 B (one shard)
+        assert by_kind["reduce-scatter"]["wire_bytes_per_device"] == \
+            3 * 1024
+        assert by_kind["collective-permute"]["wire_bytes_per_device"] \
+            == 4096
+
+    def test_predicted_seconds_divide_by_ici(self):
+        rep = comms_report(HLO_MIXED, n_devices=4, device_kind="cpu",
+                           ici_bytes_per_sec=1e6)
+        t = rep["totals"]
+        assert t["predicted_s"] == pytest.approx(
+            t["wire_bytes_per_device"] / 1e6)
+        assert rep["ici_bytes_per_sec"] == 1e6
+        assert rep["assumptions"]["algorithm"] == "ring"
+
+    def test_iota_replica_groups_decode(self):
+        rep = comms_report(HLO_MIXED, n_devices=4, device_kind="cpu")
+        by_kind = {e["kind"]: e for e in rep["collectives"]}
+        assert by_kind["all-gather"]["group_size"] == 4
+
+    def test_async_start_marked(self):
+        hlo = """
+  %ar = f32[64]{0} all-reduce-start(f32[64]{0} %p0), replica_groups={{0,1}}, to_apply=%add
+"""
+        rep = comms_report(hlo, n_devices=2, device_kind="cpu")
+        (entry,) = rep["collectives"]
+        assert entry["async_start"] is True
+
+    def test_async_start_tuple_prices_output_not_sum(self):
+        """all-gather-start's tuple result carries the INPUT shard
+        alongside the gathered output (and permute-start adds u32
+        context scalars) — the payload is member [1], not the sum."""
+        hlo = """
+  %ag = (f32[256]{0}, f32[1024]{0}) all-gather-start(f32[256]{0} %p0), replica_groups={{0,1,2,3}}, dimensions={0}
+  %cp = (f32[512]{0}, f32[512]{0}, u32[], u32[]) collective-permute-start(f32[512]{0} %p1), source_target_pairs={{0,1},{1,0}}
+"""
+        rep = comms_report(hlo, n_devices=4, device_kind="cpu")
+        by_kind = {e["kind"]: e for e in rep["collectives"]}
+        # gathered output f32[1024] = 4096 B, not 4096 + 1024
+        assert by_kind["all-gather"]["result_bytes"] == 4096
+        assert by_kind["all-gather"]["wire_bytes_per_device"] == \
+            3 / 4 * 4096
+        # one payload copy f32[512] = 2048 B, not 2x + scalars
+        assert by_kind["collective-permute"]["result_bytes"] == 2048
+        assert by_kind["collective-permute"][
+            "wire_bytes_per_device"] == 2048
+
+    def test_n_devices_defaults_from_module_header(self):
+        """The pre-flight prices compiled modules without knowing the
+        gang size — the header's num_partitions fills it in, so
+        {}-group collectives are not silently zeroed."""
+        hlo = """
+HloModule jit_step, is_scheduled=true, num_partitions=4
+ENTRY %main {
+  %p0 = f32[1024]{0} parameter(0)
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %p0), replica_groups={}, to_apply=%add
+}
+"""
+        rep = comms_report(hlo, device_kind="cpu")
+        (entry,) = rep["collectives"]
+        assert entry["group_size"] == 4
+        assert entry["wire_bytes_per_device"] == 2 * 3 / 4 * 4096
+        assert rep["assumptions"]["n_devices"] == 4
+
+    def test_write_report_wraps_list(self, tmp_path):
+        rep = comms_report(HLO_MIXED, n_devices=4, device_kind="cpu")
+        path = write_report([rep], str(tmp_path / "comms.json"))
+        doc = json.load(open(path))
+        assert doc["reports"][0]["totals"]["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# reshard_plan: shrink / grow / indivisible / host placement / HBM
+# ---------------------------------------------------------------------------
+
+
+class TestReshardPlan:
+    def test_feasible_shrink(self):
+        plan = reshard_plan(
+            [_info()], {"data": 2, "model": 4}, {"data": 1, "model": 4},
+            hbm_bytes=1e12,
+        )
+        assert plan.feasible
+        assert plan.problems == []
+        # per-device bytes: the model split (4x) is preserved either
+        # way; 16*64*4 B * 3.0 multiplier / 4
+        assert plan.per_device_bytes_target == \
+            int(16 * 64 * 4 * 3.0 / 4)
+
+    def test_feasible_grow(self):
+        plan = reshard_plan(
+            [_info()], {"data": 2, "model": 4}, {"data": 4, "model": 4},
+            hbm_bytes=1e12,
+        )
+        assert plan.feasible
+
+    def test_indivisible_dim_names_param_and_axis(self):
+        # dim 1 (size 6) cannot split 4 ways
+        info = _info(path="['lm_head']['kernel']", shape=(16, 6))
+        plan = reshard_plan(
+            [info], {"model": 2}, {"model": 4}, hbm_bytes=1e12,
+        )
+        assert not plan.feasible
+        (problem,) = plan.problems
+        assert problem.rule_id == "reshard-infeasible"
+        assert problem.severity == Severity.ERROR
+        assert problem.op == "['lm_head']['kernel']"
+        assert "'model'" in problem.message
+        assert "dim 1" in problem.message
+
+    def test_axis_absent_from_target_is_replication(self):
+        # collapsing 'model' out of the mesh replicates the dim — a
+        # legal (if memory-hungry) shrink, not an error
+        plan = reshard_plan(
+            [_info(shape=(16, 6))], {"model": 2}, {"data": 2},
+            hbm_bytes=1e12,
+        )
+        assert plan.feasible
+        assert plan.per_device_bytes_target == int(16 * 6 * 4 * 3.0)
+
+    def test_fractional_host_placement_rejected(self):
+        plan = reshard_plan(
+            [_info()], {"data": 2, "model": 4}, {"data": 1, "model": 2},
+            local_device_count=4, hbm_bytes=1e12,
+        )
+        assert not plan.feasible
+        (problem,) = plan.problems
+        assert problem.op == "mesh"
+        assert "fraction of a host" in problem.message
+
+    def test_restore_high_water_over_budget(self):
+        # new shard + one old shard resident at once must fit
+        info = _info(shape=(1024, 1024))   # 4 MiB params, 12 MiB state
+        plan = reshard_plan(
+            [info], {"model": 4}, {"model": 2},
+            hbm_bytes=8 * MiB,
+        )
+        assert not plan.feasible
+        (problem,) = plan.problems
+        assert problem.op == "hbm"
+        assert "high-water" in problem.message
+        assert "OOMs mid-restore" in problem.message
+        # 12 MiB/2 (new) + 12 MiB/4 (old) = 9 MiB > 8 MiB
+        assert plan.restore_high_water_bytes == int(
+            12 * MiB / 2 + 12 * MiB / 4)
+
+    def test_state_multiplier_scales(self):
+        plan = reshard_plan(
+            [_info()], {"model": 4}, {"model": 4},
+            hbm_bytes=1e12, state_multiplier=1.0,
+        )
+        assert plan.state_bytes_total == 16 * 64 * 4
+
+    def test_to_dict_roundtrips(self):
+        plan = reshard_plan([_info(shape=(16, 6))], {"model": 2},
+                            {"model": 4}, hbm_bytes=1e12)
+        doc = plan.to_dict()
+        assert doc["feasible"] is False
+        assert doc["problems"][0]["rule_id"] == "reshard-infeasible"
+        json.dumps(doc)   # artifact-safe
+
+
+class TestShrinkMesh:
+    def test_data_absorbs_the_shrink(self):
+        axes, reason = shrink_mesh(
+            {"data": 4, "fsdp": 2, "model": 2}, 8)
+        assert reason is None
+        assert axes == {"data": 2, "fsdp": 2, "seq": 1, "model": 2}
+
+    def test_fsdp_collapses_when_indivisible(self):
+        axes, reason = shrink_mesh({"data": 2, "fsdp": 4, "model": 1}, 2)
+        assert reason is None
+        assert axes == {"data": 2, "fsdp": 1, "seq": 1, "model": 1}
+
+    def test_np_must_be_multiple_of_model_seq(self):
+        axes, reason = shrink_mesh({"model": 4}, 6)
+        assert axes is None
+        assert "model" in reason and "4" in reason
+
+
+# ---------------------------------------------------------------------------
+# the supervisor's elastic-relaunch gate
+# ---------------------------------------------------------------------------
+
+
+class TestCheckRelaunchNp:
+    def test_unregistered_tree_is_unchecked(self):
+        assert check_relaunch_np(2) is None
+
+    def test_feasible_shrink_returns_plan(self):
+        register_gang_sharding(
+            [_info()], {"data": 2, "model": 4},
+            local_device_count=4, hbm_bytes=1e12,
+        )
+        plan = check_relaunch_np(4)
+        assert plan.feasible
+        assert plan.target_axes["model"] == 4
+
+    def test_infeasible_np_raises_typed_naming_axis(self):
+        register_gang_sharding(
+            [_info()], {"data": 2, "model": 4}, hbm_bytes=1e12,
+        )
+        with pytest.raises(ReshardPreflightError) as e:
+            check_relaunch_np(6)    # not a multiple of model=4
+        (f,) = e.value.findings
+        assert f.rule_id == "reshard-infeasible"
+        assert "model" in f.message
+
+    def test_oom_shrink_raises(self):
+        register_gang_sharding(
+            [_info(shape=(1024, 1024), spec=(("model",), ()),
+                   mesh_axes=(("model", 4),))],
+            {"model": 4, "data": 1}, hbm_bytes=5 * MiB,
+        )
+        with pytest.raises(ReshardPreflightError) as e:
+            check_relaunch_np(4)
+        assert e.value.plan is not None
+        assert any(f.op == "hbm" for f in e.value.findings)
+
+    def test_error_is_a_preflight_lint_error(self):
+        from sparkdl_tpu.analysis import PreflightLintError
+
+        register_gang_sharding([_info()], {"model": 4}, hbm_bytes=1e12)
+        with pytest.raises(PreflightLintError):
+            check_relaunch_np(3)
+
+
+def test_relaunch_env_spelling_matches_supervisor():
+    """The env contract is one string in two modules (the supervisor
+    must not import the analysis package at import time) — pin them
+    together."""
+    from sparkdl_tpu.horovod import supervisor
+
+    assert supervisor.RELAUNCH_NP_ENV == comms.RELAUNCH_NP_ENV
+
+
+class TestSupervisorRefusal:
+    """The acceptance unit test: through the REAL supervise() loop, an
+    infeasible SPARKDL_TPU_GANG_RELAUNCH_NP refuses the relaunch with
+    the typed error BEFORE any backoff sleep; a feasible shrink
+    relaunches and ships the target np to the workers."""
+
+    @staticmethod
+    def _transient_once(succeed_result="ok"):
+        from sparkdl_tpu.horovod.supervisor import GangFailure
+
+        calls = []
+
+        def launch(extra_env):
+            calls.append(dict(extra_env))
+            if len(calls) == 1:
+                raise GangFailure("gang rendezvous timed out",
+                                  kind="rendezvous_timeout")
+            return succeed_result
+
+        return launch, calls
+
+    def test_infeasible_np_refused_with_typed_error(self, monkeypatch):
+        from sparkdl_tpu.horovod.supervisor import (
+            RELAUNCH_NP_ENV,
+            RetryPolicy,
+            supervise,
+        )
+
+        register_gang_sharding(
+            [_info(path="['lm_head']['kernel']")],
+            {"data": 2, "model": 4}, hbm_bytes=1e12,
+        )
+        monkeypatch.setenv(RELAUNCH_NP_ENV, "6")
+        launch, calls = self._transient_once()
+        slept = []
+        with pytest.raises(ReshardPreflightError) as e:
+            supervise(launch, RetryPolicy(max_retries=2),
+                      _sleep=slept.append)
+        assert len(calls) == 1          # never relaunched
+        assert slept == []              # refused BEFORE the backoff
+        assert "model" in str(e.value)
+
+    def test_feasible_shrink_relaunches_and_ships_np(self, monkeypatch):
+        from sparkdl_tpu.horovod.supervisor import (
+            RELAUNCH_NP_ENV,
+            RetryPolicy,
+            supervise,
+        )
+
+        register_gang_sharding(
+            [_info()], {"data": 2, "model": 4},
+            local_device_count=4, hbm_bytes=1e12,
+        )
+        monkeypatch.setenv(RELAUNCH_NP_ENV, "4")
+        launch, calls = self._transient_once()
+        result = supervise(launch, RetryPolicy(max_retries=2),
+                           _sleep=lambda s: None)
+        assert result == "ok"
+        assert len(calls) == 2
+        assert calls[0].get(RELAUNCH_NP_ENV) is None
+        assert calls[1][RELAUNCH_NP_ENV] == "4"
+
+    def test_no_registered_tree_relaunches_unchecked(self, monkeypatch):
+        from sparkdl_tpu.horovod.supervisor import (
+            RELAUNCH_NP_ENV,
+            RetryPolicy,
+            supervise,
+        )
+
+        monkeypatch.setenv(RELAUNCH_NP_ENV, "2")
+        launch, calls = self._transient_once()
+        assert supervise(launch, RetryPolicy(max_retries=1),
+                         _sleep=lambda s: None) == "ok"
+        assert len(calls) == 2
+
+    def test_unparsable_np_is_ignored_not_fatal(self, monkeypatch):
+        from sparkdl_tpu.horovod.supervisor import (
+            RELAUNCH_NP_ENV,
+            RetryPolicy,
+            supervise,
+        )
+
+        monkeypatch.setenv(RELAUNCH_NP_ENV, "half-a-pod")
+        launch, calls = self._transient_once()
+        assert supervise(launch, RetryPolicy(max_retries=1),
+                         _sleep=lambda s: None) == "ok"
+        assert RELAUNCH_NP_ENV not in calls[1]
+
+
+# ---------------------------------------------------------------------------
+# the jax-aware registration wrapper (spec-carrying ParamInfo)
+# ---------------------------------------------------------------------------
+
+
+def test_register_gang_sharding_wrapper_builds_spec():
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkdl_tpu import analysis
+    from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    params = {"w": jnp.ones((16, 64), jnp.float32)}
+    shardings = {"w": NamedSharding(mesh, P(None, "model"))}
+    reg = analysis.register_gang_sharding(
+        params, shardings, mesh, local_device_count=4, hbm_bytes=1e12)
+    (info,) = reg["param_info"]
+    assert info.spec == ((), ("model",))
+    axes = dict(info.mesh_axes)   # make_mesh pads fsdp/seq to size 1
+    assert axes["data"] == 2 and axes["model"] == 4
+    assert reg["source_axes"]["model"] == 4
+    # ...and the registered tree drives the supervisor gate
+    assert check_relaunch_np(4).feasible
+
+
+def test_sharding_tree_info_carries_spec():
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+    from sparkdl_tpu.parallel.sharding import sharding_tree_info
+
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    (info,) = sharding_tree_info(
+        {"w": jnp.ones((8, 16), jnp.float32)},
+        {"w": NamedSharding(mesh, P("data", "model"))})
+    assert info.spec == (("data",), ("model",))
+    assert info.sharded_axes == ("data", "model")
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-measured: the analyzer's own e2e gate (ISSUE satellite)
+# ---------------------------------------------------------------------------
+
+
+def _crosscheck_main(n_steps, elems):
+    import numpy as np
+
+    import sparkdl_tpu.hvd as hvd
+
+    hvd.init()
+    x = np.full((elems,), float(hvd.rank() + 1), np.float32)
+    for _ in range(n_steps):
+        hvd.allreduce(x, op=hvd.Sum)
+    # The static twin, priced from the SAME compiled program the loop
+    # above executed (the engine caches its jitted shard_map psum by
+    # (kind, shape, dtype)) — not from hand arithmetic, so a pricing
+    # bug in comms_report fails this gate.
+    from sparkdl_tpu.analysis.comms import comms_report
+    from sparkdl_tpu.hvd import _collectives
+    from sparkdl_tpu.utils import jax_compat
+
+    eng = _collectives._engine
+    fn = eng._fns[("sum", x.shape, str(x.dtype))]
+    lowered = jax_compat.lower(fn, eng._to_global(x))
+    report = comms_report(
+        lowered.compile().as_text(), n_devices=hvd.size(),
+        name="hvd-allreduce",
+    )
+    return {
+        "rank": hvd.rank(),
+        "payload_nbytes": int(x.nbytes),
+        "predicted_per_step": report["totals"]["wire_bytes_per_device"],
+        "collectives": report["totals"]["count"],
+    }
+
+
+@pytest.mark.gang
+def test_gang_predicted_vs_measured_within_2x(monkeypatch, tmp_path):
+    """2-rank gang: the static comms budget (priced from the compiled
+    allreduce program the workers actually ran) must sit within 2x of
+    the runtime ``collective_bytes_total`` counters, per rank, per
+    step — the analyzer's own end-to-end gate."""
+    import glob
+    import re
+
+    from sparkdl import HorovodRunner
+    from sparkdl_tpu import observe
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    try:
+        n_steps, elems = 4, 1 << 14    # 64 KiB payload per step
+        result = HorovodRunner(np=-2).run(
+            _crosscheck_main, n_steps=n_steps, elems=elems)
+    finally:
+        observe._reset_for_tests()
+    predicted = result["predicted_per_step"]
+    assert result["collectives"] >= 1
+    assert predicted > 0
+
+    (run,) = glob.glob(str(tmp_path / "run-*"))
+    prom = open(os.path.join(run, "metrics.prom")).read()
+    measured = {
+        rank: float(value)
+        for rank, value in re.findall(
+            r'collective_bytes_total\{op="reduce",rank="(\d+)"\}\s+(\S+)',
+            prom)
+    }
+    assert set(measured) == {"0", "1"}, prom
+    for rank, total in measured.items():
+        per_step = total / n_steps
+        assert per_step > 0
+        ratio = per_step / predicted
+        assert 0.5 <= ratio <= 2.0, (
+            f"rank {rank}: measured {per_step:.0f} B/step vs predicted "
+            f"{predicted:.0f} B/step diverges >2x (ratio {ratio:.2f})"
+        )

@@ -593,3 +593,228 @@ class TestUndonatedStepBuffers:
             "undonated-step-buffers",
         )
         assert findings == [], "\n".join(map(str, findings))
+
+
+# ---------------------------------------------------------------------------
+# implicit-reshard (bad/clean StableHLO corpus pair)
+# ---------------------------------------------------------------------------
+
+
+def _reshard_ctx(sharding_attr, spec=((), ("model",)),
+                 mesh_axes=(("data", 1), ("model", 4))):
+    """A minimal entry signature whose %arg0 is a (16, 64) f32 param
+    arriving with ``sharding_attr``, against a ParamInfo tree whose
+    own sharding is ``spec`` under ``mesh_axes``."""
+    from sparkdl_tpu.analysis.core import ParamInfo
+
+    attr = (f' {{mhlo.sharding = "{sharding_attr}"}}'
+            if sharding_attr else "")
+    text = (
+        f'func.func public @main(%arg0: tensor<16x64xf32>{attr}, '
+        '%arg1: tensor<8x16xf32>) -> (tensor<8x64xf32>) {'
+    )
+    info = ParamInfo(
+        path="['w']", shape=(16, 64), dtype="float32",
+        sharded_axes=tuple(a for entry in spec for a in entry),
+        spec=spec, mesh_axes=mesh_axes,
+    )
+    return GraphContext(stablehlo_text=text, param_info=[info])
+
+
+class TestImplicitReshard:
+    def test_replication_round_trip_is_error(self):
+        """The program was lowered expecting the FULL (replicated)
+        param while the arrays arrive model-sharded: XLA gathers the
+        whole tensor in (and scatters carried state back out) every
+        call."""
+        from sparkdl_tpu.analysis.passes_comms import implicit_reshard
+
+        (f,) = implicit_reshard(_reshard_ctx("{replicated}"))
+        assert f.rule_id == "implicit-reshard"
+        assert f.severity == Severity.ERROR
+        assert f.op == "['w']"
+        assert "full-replication round trip" in f.message
+        assert "P(None, model)" in f.message
+
+    def test_tile_mismatch_is_warning(self):
+        """Sharded→differently-sharded is a reshard copy (WARN, with
+        both shardings and the bytes), not the full round trip."""
+        from sparkdl_tpu.analysis.passes_comms import implicit_reshard
+
+        (f,) = implicit_reshard(
+            _reshard_ctx("{devices=[4,1]<=[4]}"))
+        assert f.severity == Severity.WARNING
+        assert "reshard copy" in f.message
+        assert "[1, 4]" in f.message and "[4, 1]" in f.message
+
+    def test_matching_sharding_is_clean(self):
+        from sparkdl_tpu.analysis.passes_comms import implicit_reshard
+
+        assert implicit_reshard(
+            _reshard_ctx("{devices=[1,4]<=[4]}")) == []
+
+    def test_unannotated_arg_is_clean(self):
+        """No mhlo.sharding attr on the arg → nothing statically
+        comparable → silence, never a guess."""
+        from sparkdl_tpu.analysis.passes_comms import implicit_reshard
+
+        assert implicit_reshard(_reshard_ctx(None)) == []
+
+    def test_parse_hlo_sharding_shapes(self):
+        from sparkdl_tpu.analysis.passes_comms import parse_hlo_sharding
+
+        assert parse_hlo_sharding("{replicated}") == ()
+        assert parse_hlo_sharding("{devices=[2,1]<=[2]}") == (2, 1)
+        assert parse_hlo_sharding(
+            "{devices=[2,1,2]<=[4] last_tile_dim_replicate}") == (2, 1)
+        assert parse_hlo_sharding("{maximal device=0}") is None
+        assert parse_hlo_sharding("") is None
+
+
+# ---------------------------------------------------------------------------
+# hbm-overcommit (bad/clean memory-stats pair + target-mesh mode)
+# ---------------------------------------------------------------------------
+
+
+class TestHbmOvercommit:
+    @staticmethod
+    def _ctx(peak_bytes, capacity, **options):
+        return GraphContext(
+            memory_stats={
+                "argument_size_in_bytes": peak_bytes // 2,
+                "output_size_in_bytes": peak_bytes // 4,
+                "temp_size_in_bytes": peak_bytes // 4,
+                "alias_size_in_bytes": 0,
+            },
+            options={"hbm_bytes_per_device": capacity, **options},
+        )
+
+    def test_overcommit_is_error(self):
+        from sparkdl_tpu.analysis.passes_comms import hbm_overcommit
+
+        (f,) = hbm_overcommit(self._ctx(2 * 2**30, 1 * 2**30))
+        assert f.rule_id == "hbm-overcommit"
+        assert f.severity == Severity.ERROR
+        assert "OOMs at launch" in f.message
+
+    def test_crowded_budget_is_warning(self):
+        from sparkdl_tpu.analysis.passes_comms import hbm_overcommit
+
+        (f,) = hbm_overcommit(
+            self._ctx(int(0.95 * 2**30), 1 * 2**30))
+        assert f.severity == Severity.WARNING
+        assert "headroom" in f.message
+
+    def test_fitting_program_is_clean(self):
+        from sparkdl_tpu.analysis.passes_comms import hbm_overcommit
+
+        assert hbm_overcommit(self._ctx(2**28, 2**30)) == []
+
+    def test_no_capacity_skips(self):
+        """cpu rigs (no chip budget, no override): the pass stays
+        silent rather than inventing a denominator."""
+        from sparkdl_tpu.analysis.passes_comms import hbm_overcommit
+
+        ctx = GraphContext(
+            memory_stats={"temp_size_in_bytes": 2**40},
+            options={"hbm_bytes_per_device": None,
+                     "device_kind": "cpu"},
+        )
+        assert hbm_overcommit(ctx) == []
+
+    def test_target_mesh_mode_surfaces_reshard_problems(self):
+        """The elastic question: does the state still fit under the
+        TARGET mesh? An indivisible dim rides out as the same
+        reshard-infeasible finding the supervisor pre-flight raises."""
+        from sparkdl_tpu.analysis.core import ParamInfo
+        from sparkdl_tpu.analysis.passes_comms import hbm_overcommit
+
+        ctx = GraphContext(
+            memory_stats={"temp_size_in_bytes": 1024},
+            param_info=[ParamInfo(
+                path="['w']", shape=(16, 6), dtype="float32",
+                sharded_axes=("model",), spec=((), ("model",)),
+                mesh_axes=(("model", 2),),
+            )],
+            options={"hbm_bytes_per_device": 2**30,
+                     "target_mesh_axes": {"model": 4}},
+        )
+        findings = hbm_overcommit(ctx)
+        assert [f for f in findings
+                if f.rule_id == "reshard-infeasible"
+                and f.op == "['w']"]
+
+
+# ---------------------------------------------------------------------------
+# unoverlapped-collective (sync vs already-async corpus pair)
+# ---------------------------------------------------------------------------
+
+_SYNC_HLO = """
+HloModule step
+ENTRY %main {
+  %p0 = f32[1024]{0} parameter(0)
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  ROOT %r = f32[1024]{0} add(f32[1024]{0} %ar, f32[1024]{0} %p0)
+}
+"""
+
+_ASYNC_OVERLAPPED_HLO = """
+HloModule step
+ENTRY %main {
+  %p0 = f32[1024]{0} parameter(0)
+  %ar-start = f32[1024]{0} all-reduce-start(f32[1024]{0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  %mm = f32[1024]{0} fusion(f32[1024]{0} %p0), kind=kLoop, calls=%fused
+  %ar-done = f32[1024]{0} all-reduce-done(f32[1024]{0} %ar-start)
+  ROOT %r = f32[1024]{0} add(f32[1024]{0} %ar-done, f32[1024]{0} %mm)
+}
+"""
+
+_ASYNC_BACK_TO_BACK_HLO = """
+HloModule step
+ENTRY %main {
+  %p0 = f32[1024]{0} parameter(0)
+  %ar-start = f32[1024]{0} all-reduce-start(f32[1024]{0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  %ar-done = f32[1024]{0} all-reduce-done(f32[1024]{0} %ar-start)
+  ROOT %r = f32[1024]{0} add(f32[1024]{0} %ar-done, f32[1024]{0} %p0)
+}
+"""
+
+
+class TestUnoverlappedCollective:
+    @staticmethod
+    def _run(hlo):
+        from sparkdl_tpu.analysis.passes_comms import (
+            unoverlapped_collective,
+        )
+
+        return unoverlapped_collective(GraphContext(
+            hlo_text=hlo,
+            options={"n_devices": 4, "device_kind": "cpu"},
+        ))
+
+    def test_sync_collective_reported_with_hideable_seconds(self):
+        findings = self._run(_SYNC_HLO)
+        assert findings, "barrier-style collective not reported"
+        assert all(f.severity == Severity.INFO for f in findings)
+        summary = findings[0]
+        assert summary.op == "module"
+        assert "1 of 1 collective(s)" in summary.message
+        assert "hideable" in summary.message
+        detail = findings[1]
+        assert detail.op == "all-reduce"
+        assert "barrier-style (sync)" in detail.message
+
+    def test_async_with_compute_between_is_silent(self):
+        assert self._run(_ASYNC_OVERLAPPED_HLO) == []
+
+    def test_async_with_nothing_between_still_reported(self):
+        """Issued async but with no compute between start and done —
+        the latency is paid anyway; the pass names the wasted split."""
+        findings = self._run(_ASYNC_BACK_TO_BACK_HLO)
+        assert findings
+        assert "no compute between start and done" in \
+            findings[1].message
+
+    def test_no_collectives_no_findings(self):
+        assert self._run("ENTRY %main { ROOT %r = f32[4]{0} "
+                         "parameter(0)\n}") == []

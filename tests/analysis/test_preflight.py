@@ -85,6 +85,67 @@ class TestHookUnit:
             preflight_lint(_noop_main, {}, environ=ENV_ON)
         assert e.value.findings[0].rule_id == "host-sync-in-step"
 
+    def test_registered_step_comms_budget_collected(self):
+        """The pre-flight prices every registered compiled module's
+        collectives; the launcher drains the reports into the
+        telemetry run dir (comms_report.json)."""
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.analysis.preflight import take_comms_reports
+
+        preflight_mod.register(
+            jax.jit(lambda x: x * 2).lower(jnp.ones((4,))))
+        assert preflight_lint(_noop_main, {}, environ=ENV_ON) == []
+        (rep,) = take_comms_reports()
+        assert rep["schema"] == "sparkdl_tpu.analysis.comms_report/1"
+        assert "totals" in rep
+        assert take_comms_reports() == []   # drained exactly once
+
+    def test_registered_passes_option_still_restricts(self):
+        """The old lint_* contract: ``passes=`` on a registration
+        restricts which passes run — it must not TypeError into the
+        could-not-analyze warning path (which would silently launch a
+        gang past an ERROR-class graph bug)."""
+        import jax
+        import jax.numpy as jnp
+
+        preflight_mod.register(
+            jax.jit(lambda x: x + 1).lower(jnp.ones((4,))),
+            passes=("full-param-allgather",))
+        assert preflight_lint(_noop_main, {}, environ=ENV_ON) == []
+
+    def test_stale_comms_reports_never_leak_across_launches(self):
+        """A lint-ON launch prices its modules; a later lint-OFF
+        launch in the same process must not drain the previous
+        program's budgets into its own run dir."""
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.analysis.preflight import take_comms_reports
+
+        preflight_mod.register(
+            jax.jit(lambda x: x * 3).lower(jnp.ones((4,))))
+        preflight_lint(_noop_main, {}, environ=ENV_ON)
+        # launcher never drained (e.g. telemetry off) — the next
+        # launch with the lint disabled starts clean
+        preflight_lint(_noop_main, {}, environ={})
+        assert take_comms_reports() == []
+
+    def test_refused_launch_discards_its_comms_reports(self):
+        import jax
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.analysis.preflight import take_comms_reports
+
+        preflight_mod.register(
+            jax.jit(lambda x: x * 2).lower(jnp.ones((4,))))
+        with pytest.raises(PreflightLintError):
+            preflight_lint(
+                _noop_main, {"x": np.zeros(4, np.float64)},
+                environ=ENV_ON)
+        assert take_comms_reports() == []
+
     def test_unanalyzable_registered_artifact_never_blocks(self):
         # The lint must not turn its own crash into a launch failure.
         preflight_mod.register(lambda: 1 / 0)

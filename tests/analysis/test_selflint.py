@@ -110,6 +110,42 @@ def test_lint_paths_over_tmpdir(tmp_path):
     assert findings[0].location.startswith(str(tmp_path / "bad.py"))
 
 
+SUPPRESSED_ON_DEF = VIOLATION_SPARK.replace(
+    'spark = SparkSession.builder.appName("x").getOrCreate()',
+    'spark = SparkSession.builder.appName("x").getOrCreate()'
+    '  # sparkdl: allow-capture',
+)
+
+SUPPRESSED_ON_LOAD = VIOLATION_SPARK.replace(
+    'return spark.read.parquet("/data").count()',
+    'return spark.read.parquet("/data").count()'
+    '  # sparkdl: allow-capture',
+)
+
+
+def test_allow_capture_comment_on_definition_suppresses():
+    """`# sparkdl: allow-capture` on the module-level assignment is
+    the in-source allowlist: the intentional capture stays silent
+    without a test-side exemption."""
+    assert lint_source(SUPPRESSED_ON_DEF, "ok.py") == []
+
+
+def test_allow_capture_comment_on_load_line_suppresses():
+    """...and the same comment on the capturing load line works too
+    (the spelling for a module whose definition is shared by several
+    mains, only one of which is intentional)."""
+    assert lint_source(SUPPRESSED_ON_LOAD, "ok.py") == []
+
+
+def test_unrelated_comment_does_not_suppress():
+    text = VIOLATION_SPARK.replace(
+        'spark = SparkSession.builder.appName("x").getOrCreate()',
+        'spark = SparkSession.builder.appName("x").getOrCreate()'
+        '  # TODO tidy',
+    )
+    assert len(lint_source(text, "viol.py")) == 1
+
+
 def test_repo_self_surface_is_clean():
     """The gate CI enforces: the package, examples/, and the driver
     entry carry no pickling-contract violations."""

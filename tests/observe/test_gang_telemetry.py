@@ -70,6 +70,34 @@ def test_ingest_merges_incarnations_and_write_produces_artifacts(
     assert {"a", "b", "c", "gang.failure"} <= named
 
 
+def test_comms_reports_land_in_run_dir(tmp_path, monkeypatch):
+    """The launcher drains the pre-flight's static comms budgets into
+    the aggregator; write() puts them next to metrics.prom so the
+    doctor can set predicted against measured."""
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    gt.add_comms_reports([
+        {"schema": "sparkdl_tpu.analysis.comms_report/1",
+         "name": "step", "device_kind": "cpu",
+         "totals": {"count": 2, "wire_bytes_per_device": 1024.0,
+                    "predicted_s": 1e-7, "by_kind": {}}},
+        "not-a-report",     # shape-checked at the door, dropped
+    ])
+    paths = gt.write(str(tmp_path))
+    doc = json.loads(open(paths["comms_report.json"]).read())
+    (rep,) = doc["reports"]
+    assert rep["totals"]["wire_bytes_per_device"] == 1024.0
+
+
+def test_no_comms_reports_no_file(tmp_path, monkeypatch):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    paths = gt.write(str(tmp_path))
+    assert "comms_report.json" not in paths
+
+
 def test_malformed_snapshot_is_rejected():
     gt = GangTelemetry()
     with pytest.raises(ValueError, match="malformed"):

@@ -508,6 +508,74 @@ class TestDoctor:
         assert doctor.main([str(tmp_path)]) == 2
         assert "no telemetry artifacts" in capsys.readouterr().err
 
+    def test_comms_predicted_vs_measured_rendered(self, tmp_path,
+                                                  capsys):
+        """A run dir carrying the pre-flight's static comms budget
+        (comms_report.json) plus measured collective_bytes_total
+        counters gets the side-by-side section, including the
+        measured-per-step/predicted ratio."""
+        from sparkdl_tpu.observe import doctor
+
+        run = tmp_path / "run-3-0"
+        run.mkdir()
+        (run / "timeline.json").write_text(
+            json.dumps({"traceEvents": []}))
+        (run / "comms_report.json").write_text(json.dumps({
+            "reports": [{
+                "schema": "sparkdl_tpu.analysis.comms_report/1",
+                "name": "train_step", "device_kind": "cpu",
+                "totals": {"count": 3,
+                           "wire_bytes_per_device": 2048.0,
+                           "predicted_s": 2e-7, "by_kind": {}},
+            }]}))
+        (run / "metrics.json").write_text(json.dumps({
+            "generated_at": 0, "series": [{
+                "labels": {"rank": "0"},
+                "counters": [
+                    {"name": "collective_bytes_total",
+                     "labels": {"rank": "0", "op": "reduce"},
+                     "value": 16384},
+                    {"name": "train_step_total",
+                     "labels": {"rank": "0", "phase": "execute"},
+                     "value": 4},
+                ],
+                "gauges": [], "histograms": [],
+            }]}))
+        diag = doctor.diagnose(str(run))
+        comms = diag["comms"]
+        assert comms["predicted_wire_bytes_per_device_per_step"] \
+            == 2048.0
+        m = comms["measured_by_rank"]["0"]
+        assert m["bytes_total"] == 16384 and m["steps"] == 4
+        assert m["per_step_vs_predicted"] == 2.0   # 4096/step vs 2048
+        assert doctor.main([str(run)]) == 0
+        out = capsys.readouterr().out
+        assert "static comms budget [train_step]" in out
+        assert "2.00x the predicted budget/step" in out
+
+    def test_measured_without_budget_still_rendered(self, tmp_path):
+        """Counters but no comms_report.json (pre-flight off): the
+        measured side still shows, with no invented ratio."""
+        from sparkdl_tpu.observe import doctor
+
+        run = tmp_path / "run-4-0"
+        run.mkdir()
+        (run / "timeline.json").write_text(
+            json.dumps({"traceEvents": []}))
+        (run / "metrics.json").write_text(json.dumps({
+            "generated_at": 0, "series": [{
+                "labels": {"rank": "1"},
+                "counters": [
+                    {"name": "collective_bytes_total",
+                     "labels": {"rank": "1", "op": "allgather"},
+                     "value": 512}],
+                "gauges": [], "histograms": [],
+            }]}))
+        comms = doctor.diagnose(str(run))["comms"]
+        assert comms["predicted_wire_bytes_per_device_per_step"] is None
+        m = comms["measured_by_rank"]["1"]
+        assert "per_step_vs_predicted" not in m
+
     def test_doctor_cli_entrypoint(self, tmp_path):
         run = _write_run_dir(tmp_path, hang=True)
         repo = os.path.dirname(os.path.dirname(
