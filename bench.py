@@ -37,9 +37,15 @@ JSON line carries ``compile_seconds`` (wall time to a ready
 executable) and ``warm_start`` (True when it came from the AOT cache),
 plus ``steps_per_sec_p50``/``steps_per_sec_p99`` (rate distribution
 over repeated invocations of the measured executable; p99 is the slow
-tail) and ``hbm_high_water_bytes`` (peak device memory from the same
+tail), ``hbm_high_water_bytes`` (peak device memory from the same
 ``observe.health`` gauge exporter the gang heartbeat uses; null on
-deviceless hosts).
+deviceless hosts), and ``step_peak_bytes`` /
+``step_peak_bytes_undonated`` / ``step_donated_bytes`` (static peak of
+the measured executable from the compiled memory analysis, cpu-safe —
+the donation win as a committed number; stats ride the AOT cache entry
+so warm starts report them too). ``SPARKDL_TPU_BENCH_NO_DONATE=1``
+measures the UNFIXED (undonated) control the CI perf gate compares
+against.
 
 ORDERING CONTRACT (the bench gate's hard-earned rule): run this bench
 **before** the tier-1 pytest suite on an accelerator host — ``make
@@ -433,18 +439,29 @@ def run():
 
     # One lowering serves the AOT cache lookup and (on a miss) the
     # cold compile — the donate_argnums ride the Lowered, so the
-    # deserialized and cold paths donate identically.
-    lowered = jax.jit(run_n, donate_argnums=(0, 1)).lower(
+    # deserialized and cold paths donate identically. The carried
+    # state IS donated by default (the lint-to-fix donation contract:
+    # zero `undonated-step-buffers` findings on the repo's own step
+    # paths); SPARKDL_TPU_BENCH_NO_DONATE=1 is the UNFIXED control the
+    # CI perf gate measures against — the fix must never be slower.
+    donate = () if os.environ.get(
+        "SPARKDL_TPU_BENCH_NO_DONATE", "").strip() in ("1", "true", "yes") \
+        else (0, 1)
+    lowered = jax.jit(run_n, donate_argnums=donate).lower(
         params, opt_state, batch_data)
     t_compile0 = time.perf_counter()
     if cache_dir:
         step_cache = CompiledStepCache(cache_dir)
         run_n = step_cache.load_or_compile(lowered, name="bench_run_n")
         warm_start = step_cache.hits > 0
+        step_mem = step_cache.last_memory_stats
     else:
         # no safe cache dir: plain cold compile, still timed
         run_n = lowered.compile()
         warm_start = False
+        from sparkdl_tpu.utils.jax_compat import memory_analysis
+
+        step_mem = memory_analysis(run_n)
     compile_seconds = time.perf_counter() - t_compile0
     sys.stderr.write(
         "bench: step executable ready in %.2fs (%s)\n"
@@ -494,6 +511,31 @@ def run():
         else None
     )
 
+    # Static peak of the measured step executable (compiled memory
+    # analysis; cpu-safe, unlike the device HBM gauge above). The
+    # donation win is a committed number, not an assertion: the
+    # undonated figure is the same module WITHOUT the alias credit —
+    # what peak would be had the carried state not been donated
+    # (ROADMAP item 3 / the lint-to-fix donation contract; the fix
+    # engine's budget-delta proof reads the identical quantities).
+    step_peak_bytes = step_peak_undonated = step_donated = None
+    if step_mem:
+        # peak_bytes is THE one spelling of the formula (shared with
+        # the fix engine's budget proof), including the fallback for
+        # executables served from the XLA persistent compile cache,
+        # which deserialize without alias accounting — the donation
+        # attrs on the lowering are the exact figure.
+        from sparkdl_tpu.analysis.fixes import peak_bytes
+        from sparkdl_tpu.utils.jax_compat import lowered_stablehlo
+
+        step_peak_bytes = int(
+            peak_bytes(step_mem, lowered_stablehlo(lowered)))
+        step_peak_undonated = int(
+            step_mem.get("argument_size_in_bytes", 0)
+            + step_mem.get("output_size_in_bytes", 0)
+            + step_mem.get("temp_size_in_bytes", 0))
+        step_donated = step_peak_undonated - step_peak_bytes
+
     # Model FLOPs/token (matmul terms only, causal attention halved):
     #   forward        2N        (N = non-embedding matmul params)
     #   backward dX    2N        (chain rule through frozen weights)
@@ -531,6 +573,9 @@ def run():
         "steps_per_sec_p50": round(steps_per_sec_p50, 3),
         "steps_per_sec_p99": round(steps_per_sec_p99, 3),
         "hbm_high_water_bytes": hbm_high_water,
+        "step_peak_bytes": step_peak_bytes,
+        "step_peak_bytes_undonated": step_peak_undonated,
+        "step_donated_bytes": step_donated,
         "device_kind": device_kind,
         # who measured this: observe.compare treats records from a
         # different host fingerprint as advisory, not enforceable

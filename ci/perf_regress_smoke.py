@@ -18,6 +18,14 @@ with real regressions.
    the factor must sit safely above any plausible noise threshold a
    contended runner produces) — **must exit non-zero**, or the gate
    is decorative and the build fails loudly.
+5. Donation-fix gate (the lint-to-fix contract): run the bench once
+   more with ``SPARKDL_TPU_BENCH_NO_DONATE=1`` (the UNFIXED control)
+   and ``compare unfixed fixed`` must exit 0 — the donation fix must
+   never regress the cpu-proxy headline. The fixed run must also
+   report a non-null ``step_peak_bytes`` no larger than its
+   ``step_peak_bytes_undonated`` twin with real ``step_donated_bytes``
+   behind the difference, while the control reports zero donated
+   bytes — the committed number for the donation win.
 
 Every bench JSON, the appended history ledger, and the compare
 reports land in the artifacts dir the workflow uploads.
@@ -137,6 +145,39 @@ def main():
     if rc == 0:
         fail("a synthetic 50% slowdown passed the gate; "
              "the regression check is decorative")
+
+    # direction 3: the donation fix must never regress. Measure the
+    # UNFIXED control (donation disabled — exactly what the
+    # `undonated-step-buffers` finding describes) and gate the fixed
+    # headline against it with the same noise-aware compare.
+    with open(run2) as f:
+        rec2 = json.load(f)
+    env_nodonate = dict(env)
+    env_nodonate["SPARKDL_TPU_BENCH_NO_DONATE"] = "1"
+    undonated = os.path.join(art, "bench-undonated.json")
+    rec_und = run_bench(env_nodonate, undonated)
+    rc = compare(undonated, run2,
+                 os.path.join(art, "compare-donation-fix.json"))
+    if rc != 0:
+        fail(f"the donation-fixed bench regresses the undonated "
+             f"control (rc={rc}); the fix must never be slower — see "
+             "compare-donation-fix.json")
+    # The donation win is a committed number: the fixed run aliases
+    # real bytes (cpu-safe compiled memory analysis), the control
+    # aliases none.
+    peak, und_peak = rec2.get("step_peak_bytes"), \
+        rec2.get("step_peak_bytes_undonated")
+    if not isinstance(peak, int) or not isinstance(und_peak, int):
+        fail(f"fixed bench did not record step_peak_bytes "
+             f"(got {peak!r}/{und_peak!r})")
+    if peak > und_peak or not rec2.get("step_donated_bytes"):
+        fail(f"donation not visible in the memory analysis: peak "
+             f"{peak} vs undonated {und_peak}, donated "
+             f"{rec2.get('step_donated_bytes')!r}")
+    if rec_und.get("step_donated_bytes") != 0:
+        fail(f"the NO_DONATE control still donates "
+             f"({rec_und.get('step_donated_bytes')!r} bytes); the "
+             "control is not a control")
 
     # the ledger got one line per run
     try:

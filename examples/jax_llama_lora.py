@@ -31,11 +31,14 @@ def train(steps=20):
     params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
     mask = lora_mask(params)
     opt = optax.masked(optax.adamw(1e-4), mask)
+    # donate_argnums: params/opt_state are carried state — without
+    # donation peak HBM holds old AND new copies of both (the
+    # `undonated-step-buffers` lint, and what `--fix` auto-repairs)
     step = jax.jit(make_train_step(
         lambda p, b: cross_entropy_loss(
             model.apply({"params": p}, b["inputs"]), b["targets"]),
         opt, param_mask=mask,
-    ))
+    ), donate_argnums=(0, 1))
     state = opt.init(params)
     for i in range(steps):
         ids = jnp.asarray(

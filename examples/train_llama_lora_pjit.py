@@ -42,8 +42,12 @@ def main():
     mask = lora_mask(params)          # train adapters only
     opt = optax.masked(optax.adamw(1e-3), mask)
     opt_state = opt.init(params)
+    # donate_argnums: the carried (params, opt_state) alias their
+    # output buffers instead of doubling peak HBM — the
+    # `undonated-step-buffers` contract every repo step path honors
     step = jax.jit(make_train_step(
-        make_lm_loss_fn(model), opt, param_mask=mask))
+        make_lm_loss_fn(model), opt, param_mask=mask),
+        donate_argnums=(0, 1))
 
     with mesh:
         for i in range(5):

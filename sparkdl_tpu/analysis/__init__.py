@@ -35,8 +35,15 @@ from sparkdl_tpu.analysis.core import (
     register_pass,
     run_passes,
 )
+from sparkdl_tpu.analysis.fixes import (
+    FIX_ACTIONS,
+    FIXIT_SCHEMA,
+    Fix,
+    fix_program,
+)
 from sparkdl_tpu.analysis.preflight import (
     PREFLIGHT_ENV,
+    PREFLIGHT_FIX_ENV,
     PreflightLintError,
     register_preflight,
 )
@@ -45,8 +52,9 @@ __all__ = [
     "Finding", "GraphContext", "ParamInfo", "Severity", "all_passes",
     "max_severity", "register_pass", "run_passes", "lint_fn",
     "lint_lowered", "lint_compiled", "lint_gang", "param_info_from",
-    "PreflightLintError", "PREFLIGHT_ENV", "register_preflight",
-    "register_gang_sharding",
+    "PreflightLintError", "PREFLIGHT_ENV", "PREFLIGHT_FIX_ENV",
+    "register_preflight", "register_gang_sharding",
+    "Fix", "FIX_ACTIONS", "FIXIT_SCHEMA", "fix_program",
 ]
 
 
@@ -122,7 +130,7 @@ def _context_for(fn, args, *, compile=True, params=None, shardings=None,
     from sparkdl_tpu.utils import jax_compat
 
     ctx_mgr = mesh if mesh is not None else contextlib.nullcontext()
-    jaxpr = hlo_text = stablehlo = memory_stats = None
+    jaxpr = hlo_text = stablehlo = memory_stats = compiled = None
     with ctx_mgr:
         try:
             jaxpr = jax_compat.closed_jaxpr(fn, *args)
@@ -148,6 +156,8 @@ def _context_for(fn, args, *, compile=True, params=None, shardings=None,
         x64_enabled=jax_compat.x64_enabled(),
         memory_stats=memory_stats,
         options=options or {},
+        lowered=lowered,
+        compiled=compiled,
     )
 
 
@@ -171,7 +181,7 @@ def _lowered_context(lowered, *, params=None, shardings=None,
     info = None
     if params is not None and shardings is not None:
         info = param_info_from(params, shardings)
-    hlo_text = memory_stats = None
+    hlo_text = memory_stats = compiled = None
     if compile:
         compiled = lowered.compile()
         hlo_text = compiled.as_text()
@@ -185,6 +195,8 @@ def _lowered_context(lowered, *, params=None, shardings=None,
         x64_enabled=jax_compat.x64_enabled(),
         memory_stats=memory_stats,
         options=options or {},
+        lowered=lowered,
+        compiled=compiled,
     )
 
 
@@ -213,6 +225,7 @@ def _compiled_context(compiled, *, params=None, shardings=None,
         x64_enabled=jax_compat.x64_enabled(),
         memory_stats=jax_compat.memory_analysis(compiled),
         options=options or {},
+        compiled=compiled,
     )
 
 

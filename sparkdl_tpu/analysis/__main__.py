@@ -11,6 +11,13 @@ reaches ``--fail-on`` (default: error), so CI can gate on it.
   (``__graft_entry__.build_multichip_step``) and run the full graph
   pass suite over its jaxpr + compiled HLO — the deepest check, and
   the same artifact the tier-1 HLO canaries assert on.
+- ``--fix`` (with ``--graft``): run the verified auto-remediation
+  engine (:mod:`sparkdl_tpu.analysis.fixes`) over the program —
+  donation enforcement, weak-scalar hoisting, 64-bit narrowing —
+  verify every candidate fix with its four proofs, and key the exit
+  code off the POST-fix findings. ``--dry-run`` produces the same
+  proofs without handing the fixed program on; ``--fixit-out PATH``
+  writes the ``fixit_report/1`` JSON (the CI artifact).
 """
 
 import argparse
@@ -20,14 +27,10 @@ import sys
 from sparkdl_tpu.analysis.core import Severity, max_severity
 
 
-def _graft_findings(n_devices, with_comms=False):
-    import os
-
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+def _load_graft_entry():
+    """Import the repo's ``__graft_entry__.py`` (separated out so
+    tests can substitute a tiny program for the full multichip
+    build)."""
     import importlib.util
     from pathlib import Path
 
@@ -41,29 +44,74 @@ def _graft_findings(n_devices, with_comms=False):
     spec = importlib.util.spec_from_file_location("graft_entry", entry)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def _graft_context(n_devices):
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    mod = _load_graft_entry()
     step, params, opt_state, batch, mesh, shardings = \
         mod.build_multichip_step(n_devices)
-    from sparkdl_tpu.analysis import _context_for, run_passes
+    from sparkdl_tpu.analysis import _context_for
 
     name = f"build_multichip_step({n_devices})"
-    # One context (one trace, ONE compile) feeds both the pass suite
-    # and the comms budget; built like lint_fn (not lint_lowered) so
-    # the jaxpr-level passes — collective consistency, host-sync — see
-    # through the step, not just its compiled HLO.
+    # One context (one trace, ONE compile) feeds the pass suite, the
+    # comms budget AND the fix engine's before-side; built like
+    # lint_fn (not lint_lowered) so the jaxpr-level passes —
+    # collective consistency, host-sync — see through the step, not
+    # just its compiled HLO.
     ctx = _context_for(
         step, (params, opt_state, batch), compile=True, params=params,
         shardings=shardings, mesh=mesh, name=name,
         options={"n_devices": n_devices},
     )
+    graft = {
+        "step": step, "params": params, "opt_state": opt_state,
+        "batch": batch, "mesh": mesh, "shardings": shardings,
+        "name": name,
+    }
+    return ctx, graft
+
+
+def _graft_findings(n_devices, with_comms=False, fix=False,
+                    dry_run=False):
+    ctx, graft = _graft_context(n_devices)
+    from sparkdl_tpu.analysis import run_passes
+
     findings = run_passes(ctx)
+    fixit_report = None
+    if fix:
+        from sparkdl_tpu.analysis.fixes import fix_program
+
+        result = fix_program(
+            graft["step"],
+            (graft["params"], graft["opt_state"], graft["batch"]),
+            params=graft["params"], shardings=graft["shardings"],
+            mesh=graft["mesh"], name=graft["name"],
+            options=dict(ctx.options), apply=not dry_run,
+            ctx=ctx, findings=findings,
+        )
+        fixit_report = result.report
+        # With --fix the verdict previews the repaired program: a
+        # finding a VERIFIED fix eliminates is repairable machinery,
+        # not a launch blocker; degraded/unfixable findings remain.
+        findings = result.findings_after
+        if not dry_run:
+            ctx = result.ctx
     report = None
     if with_comms:
         from sparkdl_tpu.analysis import comms
 
         report = comms.comms_report(
-            ctx.hlo_text, n_devices=n_devices, name=name,
+            ctx.hlo_text, n_devices=n_devices, name=graft["name"],
         )
-    return findings, report
+    return findings, report, fixit_report
 
 
 def _render_comms(report):
@@ -114,6 +162,24 @@ def main(argv=None):
              "implies --comms",
     )
     parser.add_argument(
+        "--fix", action="store_true",
+        help="run the verified auto-remediation engine over the "
+             "--graft program: propose a fix per fixable finding, "
+             "verify it (finding gone, no new errors, numeric "
+             "equivalence, budget delta) and apply it; the exit code "
+             "keys off the POST-fix findings",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="with --fix: produce the full fixit report (all four "
+             "proofs per fix) without handing the fixed program on",
+    )
+    parser.add_argument(
+        "--fixit-out", metavar="PATH", default=None,
+        help="write the fixit report JSON to PATH (CI artifact); "
+             "implies --fix",
+    )
+    parser.add_argument(
         "--format", choices=("text", "json"), default="text",
     )
     parser.add_argument(
@@ -144,31 +210,45 @@ def main(argv=None):
 
     if args.list_rules:
         from sparkdl_tpu.analysis.core import rule_catalog
+        from sparkdl_tpu.analysis.fixes import FIX_ACTIONS
 
         for rule_id, (severities, doc) in rule_catalog().items():
             sev = "/".join(severities) or "-"
-            print(f"{rule_id:28s} {sev:16s} {doc}")
+            mark = ""
+            if rule_id in FIX_ACTIONS:
+                mark = f" [fixable: {FIX_ACTIONS[rule_id][0]}]"
+            print(f"{rule_id:28s} {sev:16s} {doc}{mark}")
         return 0
 
     from sparkdl_tpu.analysis.selflint import lint_paths, self_targets
 
     want_comms = args.comms or args.comms_out is not None
+    want_fix = args.fix or args.fixit_out is not None
     if want_comms and args.graft is None:
         parser.error("--comms needs --graft N (the budget is priced "
                      "from a compiled program)")
+    if want_fix and args.graft is None:
+        parser.error("--fix needs --graft N (fixes apply to a "
+                     "constructed program, not source files)")
+    if args.dry_run and not want_fix:
+        parser.error("--dry-run only modifies --fix")
     findings = []
     comms_reports = []
+    fixit_reports = []
     targets = list(args.paths)
     if args.self_lint:
         targets.extend(self_targets())
     if targets:
         findings.extend(lint_paths(targets))
     if args.graft is not None:
-        graft_findings, report = _graft_findings(
-            args.graft, with_comms=want_comms)
+        graft_findings, report, fixit_report = _graft_findings(
+            args.graft, with_comms=want_comms, fix=want_fix,
+            dry_run=args.dry_run)
         findings.extend(graft_findings)
         if report is not None:
             comms_reports.append(report)
+        if fixit_report is not None:
+            fixit_reports.append(fixit_report)
     if not targets and args.graft is None:
         parser.error("nothing to lint: give paths, --self, or --graft N")
 
@@ -176,12 +256,21 @@ def main(argv=None):
         from sparkdl_tpu.analysis.comms import write_report
 
         write_report(comms_reports, args.comms_out)
+    if args.fixit_out and fixit_reports:
+        with open(args.fixit_out, "w") as f:
+            json.dump({"reports": fixit_reports}, f, indent=2)
 
     findings.sort(key=lambda f: -int(f.severity))
     if args.format == "json":
         doc = [f.to_dict() for f in findings]
-        if want_comms:
-            doc = {"findings": doc, "comms_reports": comms_reports}
+        if want_comms or want_fix:
+            doc = {"findings": doc}
+            if want_comms:
+                doc["comms_reports"] = comms_reports
+            if want_fix:
+                doc["fixit_report"] = (
+                    fixit_reports[0] if len(fixit_reports) == 1
+                    else fixit_reports)
         print(json.dumps(doc, indent=2))
     else:
         for f in findings:
@@ -189,7 +278,13 @@ def main(argv=None):
         n_err = sum(1 for f in findings if f.severity == Severity.ERROR)
         n_warn = sum(1 for f in findings if f.severity == Severity.WARNING)
         print(f"-- {len(findings)} finding(s): {n_err} error(s), "
-              f"{n_warn} warning(s)")
+              f"{n_warn} warning(s)"
+              + (" (after --fix)" if want_fix else ""))
+        if fixit_reports:
+            from sparkdl_tpu.analysis.fixes import render_fixit_text
+
+            for rep in fixit_reports:
+                print(render_fixit_text(rep))
         for report in comms_reports:
             print(_render_comms(report))
     if args.fail_on != "never":

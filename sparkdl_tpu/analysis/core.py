@@ -107,6 +107,12 @@ class GraphContext:
     x64_enabled: bool = None      # jax_enable_x64 at trace time
     memory_stats: dict = None     # jax_compat.memory_analysis(compiled)
     options: dict = field(default_factory=dict)
+    # Artifact handles (not consumed by passes): the fix engine's
+    # result carries the final context's ``lowered`` so a caller can
+    # hand the repaired program straight to the compile cache without
+    # tracing it again.
+    lowered: object = None        # jax.stages.Lowered
+    compiled: object = None       # jax.stages.Compiled
 
 
 @dataclass(frozen=True)

@@ -32,6 +32,17 @@ import os
 
 PREFLIGHT_ENV = "SPARKDL_TPU_PREFLIGHT_LINT"
 
+# Opt-in auto-remediation on top of the lint: with
+# ``SPARKDL_TPU_PREFLIGHT_FIX=1`` (and the lint enabled), every
+# *callable* artifact registered via :func:`register` is run through
+# the verified fix engine (:mod:`sparkdl_tpu.analysis.fixes`) before
+# any worker spawns — donation enforced, scalars hoisted, 64-bit
+# payloads narrowed — and the registered entry is REPLACED by the
+# fixed program so later consumers (compile cache, re-lint) see the
+# repaired step. Unverifiable fixes degrade to the existing WARN;
+# nothing is ever silently applied without its four proofs.
+PREFLIGHT_FIX_ENV = "SPARKDL_TPU_PREFLIGHT_FIX"
+
 logger = logging.getLogger("HorovodRunner")
 
 _REGISTERED = []
@@ -41,12 +52,29 @@ _REGISTERED = []
 # run dir so observe.doctor can render predicted next to measured.
 _COMMS_REPORTS = []
 
+# Fixit reports produced by the newest preflight_lint run (one per
+# auto-fixed registered artifact) — drained by the launcher into the
+# run dir as fixit_report.json, rendered by observe.doctor.
+_FIXIT_REPORTS = []
+
 
 def take_comms_reports():
     """Drain the comms reports the last pre-flight produced."""
     out = list(_COMMS_REPORTS)
     _COMMS_REPORTS.clear()
     return out
+
+
+def take_fixit_reports():
+    """Drain the fixit reports the last pre-flight produced."""
+    out = list(_FIXIT_REPORTS)
+    _FIXIT_REPORTS.clear()
+    return out
+
+
+def fix_enabled(environ=None):
+    env = os.environ if environ is None else environ
+    return env.get(PREFLIGHT_FIX_ENV, "").strip() in ("1", "true", "yes")
 
 
 class PreflightLintError(RuntimeError):
@@ -176,10 +204,11 @@ def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
     ``kwargs`` — a 64-bit leaf shipped to one rank canonicalizes just
     as silently as one shipped to all of them."""
     # Cleared unconditionally (even disabled / about-to-raise): the
-    # launcher drains this list after EVERY preflight_lint call, and a
-    # stale report from a refused or lint-on launch must never
+    # launcher drains these lists after EVERY preflight_lint call, and
+    # a stale report from a refused or lint-on launch must never
     # describe a later lint-off launch's run dir.
     _COMMS_REPORTS.clear()
+    _FIXIT_REPORTS.clear()
     if not enabled(environ):
         return None
     from sparkdl_tpu.analysis import (
@@ -198,22 +227,76 @@ def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
             payload_findings(per_rank_kwargs, where="per_rank_kwargs")
         )
     findings.extend(_closure_findings(main))
-    for obj, args, opts in list(_REGISTERED):
+    do_fix = fix_enabled(environ)
+    for index, (obj, args, opts) in enumerate(list(_REGISTERED)):
         try:
             # ``passes=`` restricts which passes run (the old
             # lint_lowered/lint_compiled/lint_fn contract); the
             # context builders don't take it.
             opts = dict(opts)
             passes = opts.pop("passes", None)
-            if hasattr(obj, "compile"):          # Lowered
-                ctx = _lowered_context(obj, **opts)
-            elif hasattr(obj, "as_text") or hasattr(obj, "runtime_executable"):
-                ctx = _compiled_context(obj, **opts)
-            elif callable(obj):
-                ctx = _context_for(obj, args, **opts)
+            is_lowered = hasattr(obj, "compile") \
+                and not hasattr(obj, "lower")
+            is_compiled = hasattr(obj, "as_text") \
+                or hasattr(obj, "runtime_executable")
+            if do_fix and not is_compiled and callable(obj) \
+                    and passes is None:
+                # Auto-remediation (SPARKDL_TPU_PREFLIGHT_FIX=1): run
+                # the verified fix engine over the registered callable
+                # BEFORE any worker spawns. Verified fixes replace the
+                # registered entry (so the compile cache and any
+                # re-lint see the repaired step); unverifiable fixes
+                # degrade to the original finding, which is logged as
+                # the usual WARN below — never silently applied.
+                from sparkdl_tpu.analysis.fixes import fix_program
+
+                # A caller-supplied name= in the register() opts wins
+                # over the callable's __name__ (both feed the same
+                # keyword — colliding them would TypeError).
+                name = opts.pop("name", None) or getattr(
+                    obj, "__name__", f"registered[{index}]")
+                result = fix_program(obj, args, apply=True, name=name,
+                                     **opts)
+                _FIXIT_REPORTS.append(result.report)
+                if result.fn is not obj:
+                    stored = dict(opts, name=name)
+                    if passes is not None:
+                        stored["passes"] = passes
+                    _REGISTERED[index] = (
+                        result.fn, result.example_args, stored)
+                    # Scope honesty: the repair covers the DRIVER-side
+                    # lint surface (the registered artifact and every
+                    # re-lint/compile of it). A worker main that
+                    # rebuilds its own step from scratch must adopt
+                    # the reported fix itself — the report carries the
+                    # machine payload (donate_argnums et al).
+                    logger.warning(
+                        "pre-flight fix repaired registered artifact "
+                        "%s; a worker main that rebuilds this step "
+                        "must apply the reported fix itself (e.g. "
+                        "lower_train_step(donate_argnums=...) from "
+                        "the fixit report) for the gang to benefit",
+                        name)
+                ctx = result.ctx
+                findings.extend(result.findings_after)
             else:
-                continue
-            findings.extend(run_passes(ctx, passes=passes))
+                if do_fix and (is_lowered or is_compiled):
+                    logger.warning(
+                        "pre-flight fix: registered artifact %r is "
+                        "already lowered/compiled and cannot be "
+                        "re-lowered; register the callable plus "
+                        "example args to enable auto-fixes — linting "
+                        "it unfixed", obj,
+                    )
+                if is_lowered:
+                    ctx = _lowered_context(obj, **opts)
+                elif is_compiled:
+                    ctx = _compiled_context(obj, **opts)
+                elif callable(obj):
+                    ctx = _context_for(obj, args, **opts)
+                else:
+                    continue
+                findings.extend(run_passes(ctx, passes=passes))
             if ctx.hlo_text is not None:
                 # The same compiled module the passes just audited,
                 # priced: per-collective bytes-on-the-wire + predicted
@@ -244,9 +327,11 @@ def preflight_lint(main, kwargs, per_rank_kwargs=None, environ=None):
             logger.warning("pre-flight lint: %s", f)
     if errors:
         # Full list, not just the errors — the warnings are context
-        # for whoever reads the exception. The priced budgets die with
-        # the refusal: no gang, no run dir, nothing to drain them.
+        # for whoever reads the exception. The priced budgets and
+        # fixit reports die with the refusal: no gang, no run dir,
+        # nothing to drain them.
         _COMMS_REPORTS.clear()
+        _FIXIT_REPORTS.clear()
         raise PreflightLintError(findings)
     if findings:
         logger.info(

@@ -463,6 +463,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     from sparkdl_tpu.analysis.preflight import (
         preflight_lint,
         take_comms_reports,
+        take_fixit_reports,
     )
 
     preflight_lint(main, kwargs, per_rank_kwargs=per_rank_kwargs)
@@ -472,6 +473,13 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     # collective_bytes_total — observe.doctor renders the two side by
     # side (predicted-vs-measured is the analyzer's own e2e gate).
     comms_reports = take_comms_reports()
+    # With SPARKDL_TPU_PREFLIGHT_FIX=1 the pre-flight also ran the
+    # verified fix engine over every registered callable step (auto-
+    # donation et al, each applied fix carrying its four proofs).
+    # Drained the same way so the run dir carries fixit_report.json
+    # next to comms_report.json — observe.doctor renders the fixit
+    # table from it.
+    fixit_reports = take_fixit_reports()
 
     # Opt-in telemetry (SPARKDL_TPU_TELEMETRY_DIR): ONE aggregator per
     # launch_gang call spans every supervised attempt, so a chaos run's
@@ -485,6 +493,8 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
         telemetry = GangTelemetry()
         if comms_reports:
             telemetry.add_comms_reports(comms_reports)
+        if fixit_reports:
+            telemetry.add_fixit_reports(fixit_reports)
     try:
         return supervise(
             lambda extra_env: _launch_gang_once(

@@ -43,6 +43,7 @@ JSON_FILE = "metrics.json"
 HEALTH_FILE = "health.json"
 PERF_FILE = "perf.json"
 COMMS_FILE = "comms_report.json"
+FIXIT_FILE = "fixit_report.json"
 
 # perf.json keeps the newest per-step attribution rows up to this cap
 # (the aggregate components cover the whole run either way) so a
@@ -66,6 +67,7 @@ class GangTelemetry:
         self._job_dirs = []         # one per attempt (flight-rec scan)
         self._health_summaries = [] # one HangDetector summary/attempt
         self._comms_reports = []    # static comms budgets (pre-flight)
+        self._fixit_reports = []    # verified fixit reports (pre-flight)
         # The driver's global registry outlives launches (a notebook
         # driver runs many); baseline it NOW so write() reports only
         # THIS launch's driver-side movement. Worker snapshots need no
@@ -124,6 +126,17 @@ class GangTelemetry:
         ``collective_bytes_total`` counters."""
         with self._lock:
             self._comms_reports.extend(
+                r for r in reports if isinstance(r, dict)
+            )
+
+    def add_fixit_reports(self, reports):
+        """Fixit reports the launcher pre-flight produced
+        (:func:`sparkdl_tpu.analysis.fixes.fix_program` with
+        ``SPARKDL_TPU_PREFLIGHT_FIX=1``) — written to
+        ``fixit_report.json`` so ``observe.doctor`` can render the
+        suggested/applied fixes (and their four proofs) for the run."""
+        with self._lock:
+            self._fixit_reports.extend(
                 r for r in reports if isinstance(r, dict)
             )
 
@@ -247,9 +260,13 @@ class GangTelemetry:
             job_dirs = list(self._job_dirs)
             health = list(self._health_summaries)
             comms = list(self._comms_reports)
+            fixit = list(self._fixit_reports)
         if comms:
             files.append((COMMS_FILE, json.dumps(
                 {"reports": comms}, indent=2)))
+        if fixit:
+            files.append((FIXIT_FILE, json.dumps(
+                {"reports": fixit}, indent=2)))
         # Stack dumps from hang diagnosis: one text file per rank (a
         # rank dumped more than once — e.g. stall then hang — keeps
         # every dump, separated).

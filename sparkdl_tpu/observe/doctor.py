@@ -194,6 +194,47 @@ def _diagnose_comms(run_dir, by_rank):
     }
 
 
+def _diagnose_fixit(run_dir):
+    """Suggested/applied fixes (or None): the verified fixit reports
+    the launcher pre-flight wrote (``fixit_report.json``, schema
+    ``sparkdl_tpu.analysis.fixit_report/1``) — per fix: the rule, the
+    machine action, whether its four proofs held, whether it was
+    applied or degraded to the original finding, and the predicted
+    peak-HBM delta. Pure artifact math — the doctor renders the
+    remediation story without importing jax or the analysis engine."""
+    doc = _load_json(os.path.join(run_dir, "fixit_report.json"))
+    reports = [r for r in (doc or {}).get("reports", ())
+               if isinstance(r, dict)]
+    if not reports:
+        return None
+    out = []
+    for rep in reports:
+        fixes = []
+        for entry in rep.get("fixes", ()):
+            proofs = entry.get("proofs") or {}
+            mem = (proofs.get("budget_delta") or {}).get("memory") or {}
+            fixes.append({
+                "rule_id": entry.get("rule_id"),
+                "action": entry.get("action"),
+                "verified": bool(entry.get("verified")),
+                "applied": bool(entry.get("applied")),
+                "degraded": bool(entry.get("degraded")),
+                "degrade_reason": entry.get("degrade_reason"),
+                "description": (entry.get("fix") or {}).get("description"),
+                "proofs_ok": {k: bool((v or {}).get("ok"))
+                              for k, v in proofs.items()},
+                "peak_bytes_delta": mem.get("peak_bytes_delta"),
+            })
+        out.append({
+            "name": rep.get("name"),
+            "mode": rep.get("mode"),
+            "summary": rep.get("summary") or {},
+            "fixes": fixes,
+            "unfixable": len(rep.get("unfixable") or ()),
+        })
+    return {"reports": out}
+
+
 def _diagnose_serving(events, by_rank, top_n=5):
     """Serving-run section (or None for pure gang dirs): slowest
     requests by TTFT, the admission rejection/deferral breakdown, and
@@ -269,8 +310,9 @@ def diagnose(run_dir):
     ring_events = []
     for evs in recover_job_dir(run_dir).values():
         ring_events.extend(e for e in evs if isinstance(e, dict))
+    fixit = _diagnose_fixit(run_dir)
     if (timeline is None and metrics is None and health is None
-            and not ring_events):
+            and not ring_events and fixit is None):
         return None
 
     events = [e for e in (timeline or {}).get("traceEvents", ())
@@ -381,6 +423,7 @@ def diagnose(run_dir):
         "serving": _diagnose_serving(events, by_rank),
         "perf": _diagnose_perf(run_dir, events, by_rank),
         "comms": _diagnose_comms(run_dir, by_rank),
+        "fixit": fixit,
         "hang": verdict is not None,
         "verdict": verdict,
         "stalled_ranks": sorted(stalled),
@@ -499,6 +542,37 @@ def render_text(diag):
                 line += (" (no static budget to compare — the "
                          "pre-flight prices registered steps only)")
             lines.append(line)
+    fixit = diag.get("fixit")
+    if fixit:
+        for rep in fixit.get("reports", ()):
+            s = rep.get("summary") or {}
+            lines.append(
+                f"suggested fixes [{rep.get('name')}] "
+                f"({rep.get('mode')}): {s.get('proposed', 0)} "
+                f"proposed, {s.get('verified', 0)} verified, "
+                f"{s.get('applied', 0)} applied, "
+                f"{s.get('degraded', 0)} degraded"
+                + (f"; {rep['unfixable']} unfixable finding(s)"
+                   if rep.get("unfixable") else ""))
+            for fx in rep.get("fixes", ()):
+                state = ("applied" if fx.get("applied")
+                         else "verified" if fx.get("verified")
+                         else "degraded")
+                line = (f"  [{state}] {fx.get('rule_id')} -> "
+                        f"{fx.get('action')}")
+                delta = fx.get("peak_bytes_delta")
+                if isinstance(delta, (int, float)):
+                    line += f" (peak {_fmt_bytes(delta)})"
+                if fx.get("degrade_reason"):
+                    line += f": {fx['degrade_reason']}"
+                elif fx.get("description"):
+                    line += f": {fx['description']}"
+                proofs = fx.get("proofs_ok") or {}
+                if proofs:
+                    line += (" [proofs: " + ", ".join(
+                        f"{k}={'ok' if v else 'FAIL'}"
+                        for k, v in sorted(proofs.items())) + "]")
+                lines.append(line)
     srv = diag.get("serving")
     if srv:
         codes = ", ".join(f"{c}: {n}" for c, n in

@@ -79,7 +79,11 @@ DEFAULT_MAX_AOT_ENTRIES = 64
 DEFAULT_MIN_COMPILE_S = 1.0
 DEFAULT_MIN_ENTRY_BYTES = 0
 
-_AOT_FORMAT = 1
+# Format 2 added `memory_stats` to the entry (recorded at write time —
+# a deserialized executable's memory_analysis drops alias accounting,
+# and the bench's step_peak_bytes contract needs the real figures on
+# warm starts too). Format-1 entries simply cold-recompile once.
+_AOT_FORMAT = 2
 
 _persistent_cache_dir = None  # latched by enable_persistent_cache
 
@@ -203,6 +207,12 @@ class CompiledStepCache:
         os.makedirs(self.cache_dir, exist_ok=True)
         self.hits = 0
         self.misses = 0
+        # Compiled memory analysis of the newest load_or_compile
+        # result. Persisted inside the cache entry at write time
+        # because a DESERIALIZED executable's runtime drops the alias
+        # accounting (alias_size reads 0) — without the stored stats a
+        # warm-started bench would overstate its own peak.
+        self.last_memory_stats = None
 
     def _entry_path(self, fingerprint):
         return os.path.join(self.cache_dir, f"aot-{fingerprint}.bin")
@@ -232,9 +242,14 @@ class CompiledStepCache:
                     f"entry format/fingerprint mismatch "
                     f"(format={entry.get('format')!r})"
                 )
-            return jax_compat.deserialize_compiled(
+            compiled = jax_compat.deserialize_compiled(
                 entry["payload"], entry["in_tree"], entry["out_tree"]
             )
+            # Stats recorded at write time (guaranteed present since
+            # format 2): the deserialized runtime's own
+            # memory_analysis loses alias accounting.
+            self.last_memory_stats = entry.get("memory_stats")
+            return compiled
         except FileNotFoundError:
             return None
         except Exception as e:
@@ -257,6 +272,12 @@ class CompiledStepCache:
                 "payload": payload,
                 "in_tree": in_tree,
                 "out_tree": out_tree,
+                # Kept alongside the executable: deserialization loses
+                # the alias accounting, so a warm start reads the peak
+                # from here instead of a zeroed memory_analysis().
+                # load_or_compile records (and alias-corrects) the
+                # stats just before every _write.
+                "memory_stats": self.last_memory_stats,
             })
             fd, tmp = tempfile.mkstemp(
                 dir=self.cache_dir, suffix=".tmp")
@@ -341,6 +362,23 @@ class CompiledStepCache:
                     compiler_options=dict(compiler_options))
             else:
                 compiled = lowered.compile()
+        from sparkdl_tpu.utils import jax_compat
+
+        stats = jax_compat.memory_analysis(compiled)
+        if stats is not None and not stats.get("alias_size_in_bytes"):
+            # `.compile()` may have been served by the XLA persistent
+            # cache (still an AOT miss here), and a deserialized
+            # executable reports alias 0 even for donated programs.
+            # Restore the donated bytes from the lowering's own
+            # donation attrs so the stats this entry persists — and
+            # every warm start after it — stay truthful.
+            from sparkdl_tpu.analysis.fixes import donated_bytes_static
+
+            static = donated_bytes_static(
+                jax_compat.lowered_stablehlo(lowered))
+            if static:
+                stats = dict(stats, alias_size_in_bytes=static)
+        self.last_memory_stats = stats
         dt = time.perf_counter() - t0
         observe.inc("compile_cache_misses_total")
         observe.observe_value("compile_seconds", dt, source="xla")

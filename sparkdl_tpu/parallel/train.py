@@ -160,11 +160,19 @@ def instrument_step(step_fn, name="train_step"):
 
 
 def lower_train_step(step, *example_args, mesh=None,
-                     cost_name="train_step"):
+                     cost_name="train_step", donate_argnums=None):
     """Version-stable lowered-module access for a (jitted or plain)
     train step: returns the ``jax.stages.Lowered`` for
     ``step(*example_args)``, entering ``mesh`` around lowering when
     given (GSPMD programs lower against the ambient mesh).
+
+    ``donate_argnums`` re-jits the step with the given arguments
+    donated before lowering (an outer ``jax.jit`` restores donation
+    even on an already-jitted undonated step) — the manual seam for
+    applying a ``donate-step-buffers`` fix's inferred argnums
+    (:mod:`sparkdl_tpu.analysis.fixes`) by hand, so the repaired
+    step's buffers alias in the same artifact the compile cache
+    serializes.
 
     This is the artifact the static-analysis passes consume
     (:mod:`sparkdl_tpu.analysis`): lower once on the driver, then
@@ -184,6 +192,8 @@ def lower_train_step(step, *example_args, mesh=None,
 
     from sparkdl_tpu.utils import jax_compat
 
+    if donate_argnums is not None:
+        step = jax.jit(step, donate_argnums=tuple(donate_argnums))
     ctx = mesh if mesh is not None else contextlib.nullcontext()
     with ctx:
         lowered = jax_compat.lower(step, *example_args)

@@ -101,7 +101,151 @@ def test_docs_catalog_never_drifts():
         f"rules missing from docs/analysis.rst: {missing}")
 
 
+def test_docs_fixit_catalog_never_drifts():
+    """The fixit catalog is pinned the same way: every fix action id
+    (and its rule) must appear in docs/analysis.rst's Fix-its
+    section — a fixer cannot land undocumented."""
+    from pathlib import Path
+
+    from sparkdl_tpu.analysis.fixes import FIX_ACTIONS
+
+    docs = (Path(__file__).resolve().parents[2]
+            / "docs" / "analysis.rst").read_text()
+    assert "Fix-its" in docs
+    missing = [
+        item
+        for rule, (action, _) in FIX_ACTIONS.items()
+        for item in (rule, action)
+        if item not in docs
+    ]
+    assert not missing, (
+        f"fixit catalog entries missing from docs/analysis.rst: "
+        f"{missing}")
+
+
+def test_list_rules_marks_fixable(capsys):
+    from sparkdl_tpu.analysis.fixes import FIX_ACTIONS
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule, (action, _) in FIX_ACTIONS.items():
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith(rule))
+        assert f"[fixable: {action}]" in line
+    # non-fixable rules carry no marker
+    line = next(ln for ln in out.splitlines()
+                if ln.startswith("collective-consistency"))
+    assert "[fixable" not in line
+
+
 def test_comms_requires_graft():
     with pytest.raises(SystemExit) as e:
         main(["--comms", "--self"])
     assert e.value.code == 2
+
+
+def test_fix_requires_graft():
+    with pytest.raises(SystemExit) as e:
+        main(["--fix", "--self"])
+    assert e.value.code == 2
+
+
+def test_dry_run_requires_fix():
+    with pytest.raises(SystemExit) as e:
+        main(["--dry-run", "--self"])
+    assert e.value.code == 2
+
+
+# -- the --fix path over a tiny graft program --------------------------------
+#
+# The real --graft N builds the full multichip driver program
+# (seconds of XLA compile); the CLI contract under test — exit codes,
+# report schema, apply-vs-dry-run — is independent of program size,
+# so the graft entry is substituted with a single-device toy step.
+
+
+@pytest.fixture()
+def tiny_graft(monkeypatch):
+    import types
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import sparkdl_tpu.analysis.__main__ as cli
+
+    def fake_load():
+        mod = types.ModuleType("graft_entry")
+
+        def build_multichip_step(n):
+            def step(p, s, b):
+                g = jax.tree_util.tree_map(lambda x: x * 0.9, p)
+                s2 = jax.tree_util.tree_map(lambda x: x + 1.0, s)
+                return g, s2, (b * 2.0).sum()
+
+            p = {"w": jnp.ones((16, 16))}
+            s = {"w": jnp.zeros((16, 16))}
+            b = jnp.ones((4, 16))
+            # UNDONATED on purpose: the fixable corpus program.
+            return (jax.jit(step), p, s, b, None, {"w": P()})
+
+        mod.build_multichip_step = build_multichip_step
+        return mod
+
+    monkeypatch.setattr(cli, "_load_graft_entry", fake_load)
+
+
+class TestFixCli:
+    def test_dry_run_json_schema_golden(self, tiny_graft, capsys):
+        """`--fix --dry-run --format json` exit code + document shape:
+        the undonated WARNING is eliminated by a verified fix, so
+        --fail-on warning exits 0, and the report carries all four
+        proofs."""
+        rc = main(["--graft", "1", "--fix", "--dry-run",
+                   "--format", "json", "--fail-on", "warning"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        rep = doc["fixit_report"]
+        assert rep["schema"] == "sparkdl_tpu.analysis.fixit_report/1"
+        assert rep["mode"] == "dry-run"
+        assert rep["summary"]["verified"] == 1
+        assert rep["summary"]["applied"] == 0
+        (fx,) = rep["fixes"]
+        assert fx["action"] == "donate-step-buffers"
+        assert set(fx["proofs"]) == {
+            "finding_eliminated", "no_new_errors",
+            "numeric_equivalence", "budget_delta"}
+        assert all(p["ok"] for p in fx["proofs"].values())
+        assert doc["findings"] == []
+
+    def test_without_fix_the_warning_trips_fail_on(self, tiny_graft,
+                                                   capsys):
+        assert main(["--graft", "1", "--fail-on", "warning"]) == 1
+        assert "undonated-step-buffers" in capsys.readouterr().out
+
+    def test_apply_mode_reports_applied(self, tiny_graft, capsys):
+        rc = main(["--graft", "1", "--fix", "--format", "json",
+                   "--fail-on", "warning"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["fixit_report"]["mode"] == "apply"
+        assert doc["fixit_report"]["summary"]["applied"] == 1
+
+    def test_fixit_out_writes_the_artifact(self, tiny_graft, tmp_path,
+                                           capsys):
+        out = tmp_path / "fixit.json"
+        rc = main(["--graft", "1", "--fix", "--dry-run",
+                   "--fixit-out", str(out), "--fail-on", "never"])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        (rep,) = doc["reports"]
+        assert rep["schema"] == "sparkdl_tpu.analysis.fixit_report/1"
+
+    def test_text_mode_renders_the_fixit_table(self, tiny_graft,
+                                               capsys):
+        rc = main(["--graft", "1", "--fix", "--fail-on", "warning"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "(after --fix)" in out
+        assert "donate-step-buffers" in out
+        assert "proofs:" in out

@@ -1,0 +1,291 @@
+"""Verified auto-remediation corpus: one bad/clean pair per fixable
+rule — the fix applies, the re-lint is clean, numeric equivalence
+holds and the budget delta is recorded — plus the unfixable variants,
+which must DEGRADE to the original finding (never silently apply).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sparkdl_tpu.analysis import FIXIT_SCHEMA, Severity, fix_program
+from sparkdl_tpu.analysis.fixes import FIX_ACTIONS, render_fixit_text
+from sparkdl_tpu.utils.jax_compat import lowered_stablehlo
+
+PROOF_KEYS = ("finding_eliminated", "no_new_errors",
+              "numeric_equivalence", "budget_delta")
+
+
+def by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+def _train_ish(n=32):
+    """An UNDONATED toy train step: params/opt_state in, their
+    replacements out — the exact shape the donation pass flags."""
+
+    def step(p, s, b):
+        g = jax.tree_util.tree_map(lambda x: x * 0.9, p)
+        s2 = jax.tree_util.tree_map(lambda x: x + 1.0, s)
+        return g, s2, (b * 2.0).sum()
+
+    p = {"w": jnp.ones((n, n)), "v": jnp.ones((n,))}
+    s = {"w": jnp.zeros((n, n)), "v": jnp.zeros((n,))}
+    b = jnp.ones((4, n))
+    shardings = {"w": P(), "v": P()}
+    return step, (p, s, b), p, shardings
+
+
+class TestDonationFix:
+    def test_fix_applies_and_verifies(self):
+        step, args, p, sh = _train_ish()
+        res = fix_program(step, args, params=p, shardings=sh,
+                          name="toy")
+        (a,) = res.attempts
+        assert a.rule_id == "undonated-step-buffers"
+        assert a.action == "donate-step-buffers"
+        assert a.verified and a.applied and not a.degraded
+        # all four proofs, each ok
+        assert set(a.proofs) == set(PROOF_KEYS)
+        assert all(a.proofs[k]["ok"] for k in PROOF_KEYS)
+        # the fixed program donates: re-lint silent, module aliased
+        assert not by_rule(res.findings_after, "undonated-step-buffers")
+        assert "tf.aliasing_output" in lowered_stablehlo(res.lowered)
+
+    def test_budget_delta_shows_the_peak_drop(self):
+        step, args, p, sh = _train_ish()
+        res = fix_program(step, args, params=p, shardings=sh)
+        mem = res.attempts[0].proofs["budget_delta"]["memory"]
+        assert mem["peak_bytes_after"] < mem["peak_bytes_before"]
+        assert mem["peak_bytes_delta"] < 0
+
+    def test_numeric_equivalence_checked(self):
+        step, args, p, sh = _train_ish()
+        res = fix_program(step, args, params=p, shardings=sh)
+        eq = res.attempts[0].proofs["numeric_equivalence"]
+        assert eq["ok"] and eq["checked_leaves"] == 5
+        assert eq["max_abs_diff"] == 0.0
+
+    def test_clean_program_proposes_nothing(self):
+        step, args, p, sh = _train_ish()
+        donated = jax.jit(step, donate_argnums=(0, 1))
+        res = fix_program(donated, args, params=p, shardings=sh)
+        assert res.report["summary"]["proposed"] == 0
+        assert res.fn is donated
+
+    def test_partially_coverable_arg_degrades(self):
+        """params has two leaves but only ONE comes back out: donating
+        the whole argument is not expressible, so the fix must degrade
+        and the original WARNING stand."""
+
+        def step(p, b):
+            # p["w"] is updated and returned; p["v"] is consumed only.
+            return {"w": p["w"] * 0.9 + p["v"].sum()}, (b * 2.0).sum()
+
+        p = {"w": jnp.ones((32, 32)), "v": jnp.ones((32, 32))}
+        res = fix_program(step, (p, jnp.ones((4,))), params=p,
+                          shardings={"w": P(), "v": P()})
+        (a,) = res.attempts
+        assert a.degraded and not a.applied
+        assert "partially coverable" in a.degrade_reason
+        assert by_rule(res.findings_after, "undonated-step-buffers")
+
+    def test_read_only_twin_does_not_veto_the_coverable_arg(self):
+        """A read-only param-shaped input (an EMA copy, say) has no
+        output slot to alias into — it must be SKIPPED, not allowed
+        to veto donating the real carried state."""
+
+        def step(p, ema, b):
+            upd = jax.tree_util.tree_map(
+                lambda x, e: x * 0.9 + e.sum() * 0.0, p, ema)
+            return upd, (b * 2.0).sum()
+
+        p = {"w": jnp.ones((32, 32))}
+        ema = {"w": jnp.ones((32, 32))}
+        res = fix_program(step, (p, ema, jnp.ones((4,))), params=p,
+                          shardings={"w": P()})
+        (a,) = res.attempts
+        assert a.verified and a.applied, a.degrade_reason
+        assert a.fix.data["donate_argnums"] == [0]
+        assert not by_rule(res.findings_after, "undonated-step-buffers")
+
+    def test_dry_run_verifies_without_applying(self):
+        step, args, p, sh = _train_ish()
+        res = fix_program(step, args, params=p, shardings=sh,
+                          apply=False)
+        (a,) = res.attempts
+        assert a.verified and not a.applied
+        assert res.report["mode"] == "dry-run"
+        # the caller's program is untouched — fn, args AND the
+        # lowered artifact (compiling res.lowered must not smuggle
+        # the fixed program through a dry run)
+        assert res.fn is step
+        assert "tf.aliasing_output" not in lowered_stablehlo(res.lowered)
+        # ...but the verdict previews the repaired program
+        assert not by_rule(res.findings_after, "undonated-step-buffers")
+
+
+class TestManualDonationSeam:
+    def test_lower_train_step_donate_argnums(self):
+        """The manual seam for a donate-step-buffers fix: feeding the
+        inferred argnums to lower_train_step yields the aliased
+        artifact directly (even over an already-jitted undonated
+        step)."""
+        from sparkdl_tpu.parallel.train import lower_train_step
+
+        step, args, _, _ = _train_ish()
+        undonated = lower_train_step(jax.jit(step), *args)
+        assert "tf.aliasing_output" not in lowered_stablehlo(undonated)
+        donated = lower_train_step(jax.jit(step), *args,
+                                   donate_argnums=(0, 1))
+        assert "tf.aliasing_output" in lowered_stablehlo(donated)
+
+
+class TestScalarHoistFix:
+    def test_top_level_scalar_hoisted(self):
+        def f(x, lr):
+            return x * lr
+
+        res = fix_program(f, (jnp.ones((8,)), 0.5), name="scalar")
+        (a,) = res.attempts
+        assert a.action == "hoist-weak-scalar"
+        assert a.verified and a.applied
+        assert all(a.proofs[k]["ok"] for k in PROOF_KEYS)
+        # the scalar left the signature and the payload
+        assert len(res.example_args) == 1
+        assert not by_rule(res.findings_after, "host-sync-in-step")
+        # the fixed program still computes the same thing
+        out = res.fn(jnp.full((8,), 3.0))
+        np.testing.assert_allclose(np.asarray(out), 1.5)
+
+    def test_surviving_callback_lands_in_unfixable(self):
+        """A host-callback finding shares the hoistable scalars' rule
+        id but survives the hoist — it must land in the report's
+        unfixable bucket (identity-based, not rule-based)."""
+
+        def f(x, lr):
+            jax.debug.print("sum {}", x.sum())
+            return x * lr
+
+        res = fix_program(f, (jnp.ones((8,)), 0.5))
+        (a,) = res.attempts
+        assert a.applied, a.degrade_reason   # the scalar hoist
+        survivors = by_rule(res.findings_after, "host-sync-in-step")
+        assert survivors                      # the callback remains
+        unfix_ops = {u["op"] for u in res.report["unfixable"]}
+        assert any(op not in ("int", "float") for op in unfix_ops)
+
+    def test_nested_scalar_degrades(self):
+        def g(d):
+            return d["x"] * d["lr"]
+
+        res = fix_program(g, ({"x": jnp.ones((8,)), "lr": 0.5},))
+        (a,) = res.attempts
+        assert a.degraded and not a.applied
+        assert "nested" in a.degrade_reason
+        assert by_rule(res.findings_after, "host-sync-in-step")
+
+
+class TestNarrow64BitFix:
+    def test_f64_arg_narrowed_with_explicit_cast(self):
+        def h(x):
+            return x + 1.0
+
+        res = fix_program(h, (np.ones((8,), np.float64),))
+        (a,) = res.attempts
+        assert a.action == "narrow-64bit-payload"
+        assert a.verified and a.applied
+        assert all(a.proofs[k]["ok"] for k in PROOF_KEYS)
+        assert np.asarray(res.example_args[0]).dtype == np.float32
+        assert not by_rule(res.findings_after, "silent-canonicalization")
+        # equivalence vs the (canonicalizing) jitted original is exact
+        assert a.proofs["numeric_equivalence"]["max_abs_diff"] == 0.0
+
+    def test_int64_roundtrip_ok_narrowed(self):
+        def h(x):
+            return x + 1
+
+        res = fix_program(h, (np.array([3, 7], np.int64),))
+        (a,) = res.attempts
+        assert a.verified and a.applied
+        assert np.asarray(res.example_args[0]).dtype == np.int32
+
+    def test_int64_overflow_degrades_to_the_error(self):
+        def h(x):
+            return x + 1
+
+        res = fix_program(h, (np.array([2 ** 40], np.int64),))
+        (a,) = res.attempts
+        assert a.degraded and not a.applied
+        assert "round-trip" in a.degrade_reason
+        errs = by_rule(res.findings_after, "silent-canonicalization")
+        assert errs and errs[0].severity == Severity.ERROR
+
+
+class TestFixitReport:
+    def test_schema_and_proof_shape(self):
+        step, args, p, sh = _train_ish()
+        res = fix_program(step, args, params=p, shardings=sh)
+        rep = res.report
+        assert rep["schema"] == FIXIT_SCHEMA
+        assert rep["mode"] == "apply"
+        assert rep["summary"]["proposed"] == 1
+        assert rep["summary"]["applied"] == 1
+        (fx,) = rep["fixes"]
+        assert set(fx["proofs"]) == set(PROOF_KEYS)
+        assert fx["fix"]["preconditions"]
+        assert fx["fix"]["predicted_effect"]["peak_hbm_bytes_saved"] > 0
+        assert fx["fix"]["data"]["donate_argnums"] == [0, 1]
+        # the whole report is JSON-serializable (the CI artifact)
+        json.dumps(rep)
+
+    def test_every_fixable_rule_has_an_action(self):
+        assert set(FIX_ACTIONS) == {
+            "undonated-step-buffers", "host-sync-in-step",
+            "silent-canonicalization",
+        }
+
+    def test_render_text_mentions_state_and_proofs(self):
+        step, args, p, sh = _train_ish()
+        res = fix_program(step, args, params=p, shardings=sh)
+        text = render_fixit_text(res.report)
+        assert "[applied]" in text
+        assert "donate-step-buffers" in text
+        assert "proofs:" in text
+
+
+class TestComposition:
+    def test_all_three_rules_fixed_in_one_pass(self):
+        """A program tripping every fixable rule at once — a 64-bit
+        payload, a Python-scalar arg AND an undonated carried state:
+        the engine narrows, then hoists, then donates (argument
+        transforms before the re-jit), each step verified against the
+        previous program, and the final program is clean of all
+        three."""
+
+        def step(p, b, lr):
+            return (jax.tree_util.tree_map(lambda x: x * lr, p),
+                    (b * 2.0).sum())
+
+        p = {"w": jnp.ones((32, 32))}
+        b64 = np.ones((4, 32), np.float64)
+        res = fix_program(step, (p, b64, 0.5), params=p,
+                          shardings={"w": P()})
+        by_action = {a.action: a for a in res.attempts}
+        assert set(by_action) == {"narrow-64bit-payload",
+                                  "hoist-weak-scalar",
+                                  "donate-step-buffers"}
+        for a in res.attempts:
+            assert a.verified and a.applied, (a.action,
+                                              a.degrade_reason)
+        assert not res.findings_after
+        # final program: scalar gone from the signature, args
+        # narrowed, state donated
+        assert len(res.example_args) == 2
+        assert np.asarray(res.example_args[1]).dtype == np.float32
+        assert "tf.aliasing_output" in lowered_stablehlo(res.lowered)
+        assert res.report["summary"]["applied"] == 3

@@ -233,3 +233,143 @@ class TestLauncherWiring:
         with pytest.raises(PreflightLintError):
             HorovodRunner(np=-1).run(
                 _noop_main, sizes=np.zeros(4, np.float64))
+
+
+def _undonated_step():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def step(p, s, b):
+        g = jax.tree_util.tree_map(lambda x: x * 0.9, p)
+        return g, jax.tree_util.tree_map(lambda x: x + 1.0, s), b.sum()
+
+    p = {"w": jnp.ones((16, 16))}
+    s = {"w": jnp.zeros((16, 16))}
+    b = jnp.ones((4, 16))
+    return step, (p, s, b), {"params": p, "shardings": {"w": P()}}
+
+
+FIX_ENV_ON = dict(ENV_ON, SPARKDL_TPU_PREFLIGHT_FIX="1")
+
+
+class TestPreflightFix:
+    """SPARKDL_TPU_PREFLIGHT_FIX=1: the verified fix engine runs over
+    every registered callable step on the driver — before any worker
+    spawn — and the registered entry is replaced by the repaired
+    program. Default: inert (the WARN stands, nothing rewritten)."""
+
+    def test_fix_env_auto_donates_registered_step(self):
+        from sparkdl_tpu.utils.jax_compat import (
+            lower,
+            lowered_stablehlo,
+        )
+
+        step, args, opts = _undonated_step()
+        preflight_mod.register(step, *args, **opts)
+        findings = preflight_lint(_noop_main, {}, environ=FIX_ENV_ON)
+        # the undonated WARN was fixed, not merely logged
+        assert not [f for f in findings
+                    if f.rule_id == "undonated-step-buffers"]
+        (report,) = preflight_mod.take_fixit_reports()
+        assert report["schema"] == \
+            "sparkdl_tpu.analysis.fixit_report/1"
+        (fx,) = report["fixes"]
+        assert fx["action"] == "donate-step-buffers"
+        assert fx["applied"] and fx["verified"]
+        assert all(p["ok"] for p in fx["proofs"].values())
+        # the REGISTERED entry now lowers with donation — what the
+        # compile cache / a re-lint will consume
+        fixed_obj, fixed_args, _ = preflight_mod._REGISTERED[0]
+        assert fixed_obj is not step
+        assert "tf.aliasing_output" in lowered_stablehlo(
+            lower(fixed_obj, *fixed_args))
+
+    def test_named_registration_fixes_without_colliding(self):
+        """register(..., name=...) is valid lint input; the fix path
+        must honor it instead of TypeError-ing on a duplicate
+        keyword (which would silently skip the lint entirely)."""
+        step, args, opts = _undonated_step()
+        preflight_mod.register(step, *args, name="my_step", **opts)
+        findings = preflight_lint(_noop_main, {}, environ=FIX_ENV_ON)
+        assert not [f for f in findings
+                    if f.rule_id == "undonated-step-buffers"]
+        (report,) = preflight_mod.take_fixit_reports()
+        assert report["name"] == "my_step"
+        assert report["summary"]["applied"] == 1
+        # the replaced entry keeps its name for later re-lints
+        assert preflight_mod._REGISTERED[0][2].get("name") == "my_step"
+
+    def test_default_stays_inert(self):
+        step, args, opts = _undonated_step()
+        preflight_mod.register(step, *args, **opts)
+        findings = preflight_lint(_noop_main, {}, environ=ENV_ON)
+        # lint-on, fix-off: the WARN is logged, nothing rewritten
+        assert [f for f in findings
+                if f.rule_id == "undonated-step-buffers"]
+        assert preflight_mod.take_fixit_reports() == []
+        assert preflight_mod._REGISTERED[0][0] is step
+
+    def test_lowered_artifact_degrades_with_a_warning(self, caplog):
+        import logging
+
+        from sparkdl_tpu.utils.jax_compat import lower
+
+        step, args, opts = _undonated_step()
+        preflight_mod.register(lower(step, *args), **opts)
+        with caplog.at_level(logging.WARNING, logger="HorovodRunner"):
+            findings = preflight_lint(_noop_main, {},
+                                      environ=FIX_ENV_ON)
+        # cannot re-lower a Lowered: linted unfixed, WARN stands
+        assert [f for f in findings
+                if f.rule_id == "undonated-step-buffers"]
+        assert preflight_mod.take_fixit_reports() == []
+        assert any("cannot be re-lowered" in r.message
+                   for r in caplog.records)
+
+    def test_unverifiable_fix_degrades_to_the_warn(self):
+        """The partial-output corpus program: donation is not
+        expressible, so the pre-flight must keep the original WARN
+        and report the degrade — never silently apply."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        def step(p, b):
+            return {"w": p["w"] * 0.9 + p["v"].sum()}, b.sum()
+
+        p = {"w": jnp.ones((16, 16)), "v": jnp.ones((16, 16))}
+        preflight_mod.register(
+            step, p, jnp.ones((4,)), params=p,
+            shardings={"w": P(), "v": P()})
+        findings = preflight_lint(_noop_main, {}, environ=FIX_ENV_ON)
+        assert [f for f in findings
+                if f.rule_id == "undonated-step-buffers"]
+        (report,) = preflight_mod.take_fixit_reports()
+        assert report["summary"]["degraded"] == 1
+        assert report["summary"]["applied"] == 0
+
+    def test_launcher_fixes_before_spawn(self, popen_tripwire,
+                                         monkeypatch):
+        """Through the REAL gang-launch path: with both envs set the
+        registered step is donated BEFORE the launcher reaches worker
+        spawn (the tripwire) — `SPARKDL_TPU_PREFLIGHT_FIX=1` donates
+        before spawn."""
+        monkeypatch.setenv(PREFLIGHT_ENV, "1")
+        monkeypatch.setenv("SPARKDL_TPU_PREFLIGHT_FIX", "1")
+        monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "0")
+        step, args, opts = _undonated_step()
+        preflight_mod.register(step, *args, **opts)
+        with pytest.raises(Exception) as e:
+            HorovodRunner(np=-2).run(_noop_main)
+        assert not isinstance(e.value, PreflightLintError)
+        # spawn was reached (the run died on the tripwire), and by
+        # then the registered entry had already been repaired
+        from sparkdl_tpu.utils.jax_compat import (
+            lower,
+            lowered_stablehlo,
+        )
+
+        fixed_obj, fixed_args, _ = preflight_mod._REGISTERED[0]
+        assert fixed_obj is not step
+        assert "tf.aliasing_output" in lowered_stablehlo(
+            lower(fixed_obj, *fixed_args))
