@@ -1,7 +1,18 @@
 #!/usr/bin/env python
-"""Flash-attention kernel vs XLA reference across sequence lengths on
-the local chip. Timing uses one jitted scan + host readback (see
-bench.py for why)."""
+"""Attention benchmarks: flash kernel vs XLA reference across sequence
+lengths, plus the ring-attention overlap-vs-serialized schedule pair
+(ISSUE 10). Timing uses one jitted scan + host readback (see bench.py
+for why).
+
+Every metric reports ``p50``/``p99`` over ``REPS`` timed invocations
+and the run appends ONE schema-versioned line to the PR 7 ledger
+(``benchmarks/results/history.jsonl``), so an attention/overlap win is
+a row ``python -m sparkdl_tpu.observe.compare`` can gate on — not a
+one-off stdout line.
+
+``--tiny`` (or ``SPARKDL_TPU_BENCH_TINY=1``) shrinks shapes for smoke
+runs on deviceless hosts.
+"""
 
 import json
 import os
@@ -13,10 +24,16 @@ import time
 
 import numpy as np
 
+REPS = 5
 
-def timed(fn, q, n_steps=10):
+
+def timed(fn, q, n_steps=10, reps=REPS):
+    """One ledger metric (ms/step, ``perf.sample_metric`` shape) over
+    ``reps`` timed invocations of a jitted ``n_steps`` scan."""
     import jax
     import jax.numpy as jnp
+
+    from sparkdl_tpu.observe import perf
 
     @jax.jit
     def many(q):
@@ -27,33 +44,111 @@ def timed(fn, q, n_steps=10):
         out, _ = jax.lax.scan(body, 0.0, None, length=n_steps)
         return out
 
-    _ = np.asarray(many(q))
-    t0 = time.perf_counter()
-    _ = np.asarray(many(q))
-    return (time.perf_counter() - t0) / n_steps
+    _ = np.asarray(many(q))        # compile
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _ = np.asarray(many(q))
+        samples.append((time.perf_counter() - t0) / n_steps * 1e3)
+    return perf.sample_metric(samples, unit="ms")
 
 
-def main():
+def kernel_section(seqs, tiny):
     import jax.numpy as jnp
 
     from sparkdl_tpu.ops.attention import flash_attention
     from sparkdl_tpu.parallel.ring_attention import attention_reference
 
     rng = np.random.RandomState(0)
-    rows = []
-    for s in (1024, 2048, 4096, 8192):
-        b, h, d = max(1, 8192 // s), 8, 128
+    rows, metrics = [], {}
+    for s in seqs:
+        b = max(1, (1024 if tiny else 8192) // s)
+        h, d = (2, 32) if tiny else (8, 128)
         q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
-        tf = timed(lambda q_, k_, v_: flash_attention(q_, k_, v_,
-                                                      causal=True), q)
-        tr = timed(lambda q_, k_, v_: attention_reference(q_, k_, v_,
-                                                          causal=True), q)
+        flash = timed(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True), q)
+        xla = timed(
+            lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=True),
+            q)
         rows.append({
-            "seq": s, "flash_ms": round(tf * 1e3, 2),
-            "xla_ms": round(tr * 1e3, 2),
-            "speedup": round(tr / tf, 2),
+            "seq": s,
+            "flash_ms_p50": flash["p50"], "flash_ms_p99": flash["p99"],
+            "xla_ms_p50": xla["p50"], "xla_ms_p99": xla["p99"],
+            "speedup": (round(xla["p50"] / flash["p50"], 2)
+                        if flash["p50"] else None),
         })
-    print(json.dumps({"benchmark": "flash_attention_vs_xla", "rows": rows}))
+        metrics[f"flash_ms_s{s}"] = flash
+        metrics[f"xla_ms_s{s}"] = xla
+    return rows, metrics
+
+
+def ring_section(tiny):
+    """Overlap-vs-serialized ring schedules on a (1, N)-device mesh —
+    the before/after pair for the ISSUE 10 hop restructure. On a
+    single-chip/CPU host this measures the schedule's compute cost
+    (the wire win needs a real interconnect); the ledger row keeps the
+    trajectory either way."""
+    import jax
+
+    n = min(4, jax.device_count())
+    if n < 2:
+        return None, {}
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sparkdl_tpu.parallel.ring_attention import ring_self_attention
+    from sparkdl_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(1, n),
+                ("data", "seq"))
+    spec = P("data", "seq", None, None)
+    rng = np.random.RandomState(1)
+    b, s, h, d = (2, 64 * n, 2, 16) if tiny else (4, 512 * n, 4, 64)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+    rows, metrics = [], {}
+    out = {}
+    for name, overlap in (("overlap", True), ("serialized", False)):
+        ring = jax.jit(shard_map(
+            partial(ring_self_attention, axis_name="seq", causal=True,
+                    overlap=overlap),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        ))
+        met = timed(ring, q, n_steps=4)
+        out[name] = np.asarray(ring(q, q, q))
+        rows.append({"schedule": name, "ring_ms_p50": met["p50"],
+                     "ring_ms_p99": met["p99"]})
+        metrics[f"ring_{name}_ms"] = met
+    return {
+        "devices": n, "seq": s,
+        "bit_exact": bool(np.array_equal(out["overlap"],
+                                         out["serialized"])),
+        "rows": rows,
+    }, metrics
+
+
+def main():
+    tiny = ("--tiny" in sys.argv
+            or os.environ.get("SPARKDL_TPU_BENCH_TINY", "") not in ("", "0"))
+    from sparkdl_tpu.observe import perf
+
+    seqs = (256, 512) if tiny else (1024, 2048, 4096, 8192)
+    rows, metrics = kernel_section(seqs, tiny)
+    ring, ring_metrics = ring_section(tiny)
+    metrics.update(ring_metrics)
+    record = perf.history_record(
+        metrics, device_kind=perf.device_kind(), bench="attention_bench")
+    history = perf.append_history(record)
+    print(json.dumps({
+        "benchmark": "flash_attention_vs_xla",
+        "tiny": tiny,
+        "rows": rows,
+        "ring": ring,
+        "history": history,
+    }))
 
 
 if __name__ == "__main__":

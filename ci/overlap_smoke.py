@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""CI overlap smoke (ISSUE 10): boot the 2-rank ring-attention overlap
+gang and FAIL the build unless the merged ``perf.json`` reports
+``overlap_efficiency > 0`` — the meter PR 7 built reading 0.0 by
+construction until the async-collective/compute overlap landed. Also
+asserts the overlapped ring lowering stayed bit-exact against the
+serialized one, and runs ``observe.doctor`` over the run dir so a red
+build's attribution report is one click away in the uploaded
+artifacts.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/overlap_smoke.py``
+(defaults the dir to ``./overlap-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+# Runnable as `python ci/overlap_smoke.py` from a checkout: the script
+# dir (ci/) is sys.path[0], the package root is one up.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg):
+    print(f"OVERLAP SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    art = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "overlap-artifacts"))
+    os.makedirs(art, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+
+    from sparkdl import HorovodRunner
+    from tests.observe.test_overlap_gang import _overlap_gang_main
+
+    result = HorovodRunner(np=-2).run(_overlap_gang_main, n_steps=4)
+    if result.get("size") != 2:
+        fail(f"expected a 2-rank gang, got {result!r}")
+    if not result.get("bit_exact"):
+        fail("overlapped ring lowering diverged from the serialized one")
+    if not result.get("async_matches_sync"):
+        fail("allreduce_async result diverged from sync allreduce")
+    if not result.get("mutation_safe"):
+        fail("allreduce_async read the caller's buffer after "
+             "mutation — the defensive submit-time copy is gone")
+
+    runs = glob.glob(os.path.join(art, "run-*"))
+    if len(runs) != 1:
+        fail(f"expected exactly one run dir under {art}, found {runs}")
+    run = runs[0]
+    perf_path = os.path.join(run, "perf.json")
+    try:
+        doc = json.load(open(perf_path))
+    except (OSError, ValueError) as e:
+        fail(f"perf.json missing/malformed: {e}")
+    for rank in ("0", "1"):
+        rep = doc.get("ranks", {}).get(rank)
+        if not rep:
+            fail(f"no attribution report for rank {rank}")
+        eff = rep.get("overlap_efficiency")
+        if not eff or eff <= 0:
+            fail(f"rank {rank} overlap_efficiency={eff!r} "
+                 "(expected > 0): the collective never overlapped "
+                 "compute")
+        if rep.get("overlapped_collective_s", 0) <= 0:
+            fail(f"rank {rank} reports no overlapped collective time")
+        print(f"rank {rank}: overlap_efficiency={eff:.3f}, "
+              f"overlapped={rep['overlapped_collective_s']*1e3:.1f}ms "
+              f"of {rep['collective_total_s']*1e3:.1f}ms collective")
+
+    # the doctor must render the attribution (report uploaded beside
+    # the run dir)
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    report = os.path.join(art, "doctor-report.txt")
+    with open(report, "w") as f:
+        f.write(proc.stdout or proc.stderr)
+    if proc.returncode not in (0,):
+        fail(f"doctor exited {proc.returncode} on a healthy overlap "
+             f"run (see {report})")
+    if "where the time went" not in (proc.stdout or ""):
+        fail("doctor report lacks the attribution section")
+    print(f"overlap smoke OK: artifacts under {art}")
+
+
+if __name__ == "__main__":
+    main()

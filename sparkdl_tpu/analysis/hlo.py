@@ -182,6 +182,24 @@ def role_sequences(cols):
     }
 
 
+def computation_spans(hlo_text):
+    """Line-index ranges ``[(start, end))`` of each computation body in
+    the module text (the printer's convention: a header line ending in
+    ``{``, a closing line that is exactly ``}``). Layout/replica-group
+    braces live inside single lines and never trip this."""
+    lines = hlo_text.splitlines()
+    spans = []
+    start = None
+    for i, line in enumerate(lines):
+        s = line.strip()
+        if start is None and s.endswith("{"):
+            start = i + 1
+        elif start is not None and s == "}":
+            spans.append((start, i))
+            start = None
+    return spans
+
+
 HOST_SYNC_PATTERNS = (
     # custom-call targets jax uses for host callbacks
     (re.compile(r'custom-call.*custom_call_target="'

@@ -269,10 +269,25 @@ def hbm_overcommit(ctx):
 
 # -- unoverlapped-collective -------------------------------------------------
 
+_COMPUTE_OPS = ("fusion", "dot", "convolution", "while", "custom-call",
+                "call", "conditional", "reduce", "reduce-window",
+                "scatter", "sort")
 _COMPUTE_RE = re.compile(
-    r"=\s*\S+\s+(fusion|dot|convolution|while|custom-call|call)\("
+    r"=\s*\S+\s+(" + "|".join(_COMPUTE_OPS) + r")\("
 )
-_RESULT_VAR_RE = re.compile(r"^\s*(%?[\w.\-]+)\s*=")
+_RESULT_VAR_RE = re.compile(r"^\s*(?:ROOT\s+)?(%?[\w.\-]+)\s*=")
+# opcode of a definition line: `%x = <type> <opcode>(...)`; the type is
+# either a tuple `( ... )` or a single `f32[...]{...}` token
+_DEF_OP_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|\S+)\s+([a-zA-Z][\w\-]*)\("
+)
+# ops that merely re-route a value: a collective whose operand chains
+# through these to carried state (loop parameter / tuple element) can
+# start the moment the iteration does
+_PASSTHRU_OPS = frozenset((
+    "copy", "bitcast", "reshape", "transpose", "convert",
+    "get-tuple-element", "slice", "dynamic-slice",
+))
 
 
 def _async_has_compute_between(lines, start_i, kind, var):
@@ -290,17 +305,195 @@ def _async_has_compute_between(lines, start_i, kind, var):
     return saw_compute
 
 
+def _operand_group(line, opcode):
+    """The paren-balanced operand list of ``opcode(...)`` on a def
+    line — operand types may themselves be tuples, so a cut at the
+    first ``)`` would drop operands; attributes after the closing
+    paren (``calls=%...``, ``to_apply=%...``) must stay out."""
+    start = line.find(opcode + "(")
+    if start < 0:
+        return ""
+    depth = 0
+    for j in range(start + len(opcode), len(line)):
+        if line[j] == "(":
+            depth += 1
+        elif line[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[start + len(opcode) + 1:j]
+    return line[start + len(opcode) + 1:]
+
+
+def _split_top_level(text):
+    """Split an operand list at commas OUTSIDE parens/braces (operand
+    types may be tuples, layouts use braces)."""
+    chunks, depth, start = [], 0, 0
+    for j, ch in enumerate(text):
+        if ch in "({":
+            depth += 1
+        elif ch in ")}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            chunks.append(text[start:j])
+            start = j + 1
+    chunks.append(text[start:])
+    return chunks
+
+
+def _operand_vars(args):
+    """Operand names from an operand-list string: each top-level chunk
+    is ``<type> <name>`` and the NAME is the last token — matching
+    both ``%``-sigiled and sigil-less printer styles (an
+    operand-extraction miss would make a serialized collective look
+    like it feeds nothing, i.e. silence — forbidden here)."""
+    out = []
+    for chunk in _split_top_level(args):
+        toks = chunk.split()
+        if toks:
+            out.append(toks[-1])
+    return out
+
+
+def _computation_defs(lines, span):
+    """``(defs, root)``: var -> (opcode, [operand vars]) for every
+    definition inside one computation body, plus the ROOT var."""
+    defs = {}
+    root = None
+    for i in range(*span):
+        line = lines[i]
+        m = _RESULT_VAR_RE.match(line)
+        if m is None:
+            continue
+        om = _DEF_OP_RE.search(line)
+        if om is None:
+            continue
+        args = _operand_group(line, om.group(1))
+        defs[m.group(1)] = (om.group(1), _operand_vars(args))
+        if line.lstrip().startswith("ROOT "):
+            root = m.group(1)
+    return defs, root
+
+
+def _ancestor_vars(seeds, defs):
+    """Transitive closure of defining vars reachable upward from
+    ``seeds`` through the computation's dependence graph — everything
+    that must execute before the seeds are available."""
+    seen = set()
+    stack = list(seeds)
+    while stack:
+        var = stack.pop()
+        if var in seen:
+            continue
+        seen.add(var)
+        d = defs.get(var)
+        if d is not None:
+            stack.extend(d[1])
+    return seen
+
+
+def _descendant_vars(seed, defs):
+    """Transitive closure of vars reachable downward from ``seed`` —
+    everything that cannot start before the seed completes."""
+    seen = {seed}
+    changed = True
+    while changed:
+        changed = False
+        for user, (_op, operands) in defs.items():
+            if user not in seen and any(o in seen for o in operands):
+                seen.add(user)
+                changed = True
+    return seen
+
+
+def _feeds_compute(var, defs, root, depth=8):
+    """Is ``var`` consumed (through value-routing ops, including
+    interior tuples) by a compute op in the same computation? Feeding
+    the ROOT tuple (the loop back-edge) or a ``while``'s carried-state
+    tuple means nobody in THIS region waits on the value — the
+    consumption is deferred to the next iteration, which is the whole
+    point of the double-buffered schedule. (A ``while`` that consumes
+    the value STILL cannot start before it arrives; that side is
+    handled by the descendant exclusion in
+    :func:`_sync_collective_hidden` — compute downstream of the
+    collective never counts as something to hide under.)
+
+    An unresolved chain (depth exhausted) counts as *feeding compute*:
+    every give-up path in this pass must fall through to "report",
+    never to silence."""
+    if depth <= 0:
+        return True
+    for user, (opcode, operands) in defs.items():
+        if user == var or var not in operands:
+            continue
+        if opcode == "while" or (opcode == "tuple" and user == root):
+            continue
+        if opcode in _COMPUTE_OPS:
+            return True
+        if (opcode in _PASSTHRU_OPS or opcode == "tuple") and \
+                _feeds_compute(user, defs, root, depth - 1):
+            return True
+    return False
+
+
+def _sync_collective_hidden(lines, spans, line_i, col_var):
+    """A *sync* collective counts as hidden/hideable when its dataflow
+    lets a scheduler run it concurrently with compute in the same
+    computation: its result feeds no compute here (only the loop
+    back-edge tuple / root — nobody waits on the wire this
+    iteration), and at least one compute op is NOT an ancestor of its
+    operands (so the hop and that compute have no ordering between
+    them). This is exactly the double-buffered ring/pipeline shape;
+    XLA's async collective scheduler and while-loop collective
+    pipeliner split such ops into start/done pairs that ride under
+    the independent compute. A collective whose result is consumed by
+    this region's compute, or whose every compute neighbor must run
+    before it, sits on the critical path and is reported."""
+    span = next((s for s in spans if s[0] <= line_i < s[1]), None)
+    if span is None:
+        return False
+    defs, root = _computation_defs(lines, span)
+    d = defs.get(col_var)
+    if d is None:
+        return False
+    _, operands = d
+    if _feeds_compute(col_var, defs, root):
+        return False
+    # Compute to hide under must be ORDER-INDEPENDENT of the hop:
+    # neither an ancestor of its operands (must finish first) nor a
+    # descendant of its result (cannot start until the wire is done —
+    # e.g. a while loop whose init tuple carries the result: the loop
+    # body is compute, but it waits on the collective).
+    ancestors = _ancestor_vars(operands, defs)
+    blocked = ancestors | _descendant_vars(col_var, defs)
+    return any(
+        opcode in _COMPUTE_OPS and var not in blocked
+        for var, (opcode, _ops) in defs.items()
+    )
+
+
 @register_pass("unoverlapped-collective", requires=("hlo_text",),
                severities=("INFO",))
 def unoverlapped_collective(ctx):
-    """Report barrier-style collectives with no interleaved compute —
+    """Report collectives the program serializes against its compute —
     statically-predicted hideable seconds, the target list for
     async-overlap work (the static twin of the measured
-    overlap_efficiency)."""
+    overlap_efficiency).
+
+    Hidden (silent) forms: an async ``-start``/``-done`` pair with
+    compute between the halves, and a sync collective whose dataflow
+    already permits overlap — operands carried/external, result
+    consumed only across the loop back-edge, compute in the region to
+    hide under (the double-buffered ring/pipeline lowering; XLA's
+    async scheduler runs such ops concurrently). Reported forms: a
+    sync collective whose operand or result ties it to this region's
+    compute (the hop sits on the critical path), an async pair with
+    nothing between start and done, and any collective in a region
+    with no compute at all."""
     cols = hlo_mod.collectives(ctx.hlo_text)
     if not cols:
         return []
     lines = ctx.hlo_text.splitlines()
+    spans = hlo_mod.computation_spans(ctx.hlo_text)
     line_index = {}
     for i, line in enumerate(lines):
         line_index.setdefault(line.strip(), i)
@@ -308,12 +501,15 @@ def unoverlapped_collective(ctx):
     device_kind = ctx.options.get("device_kind")
     unhidden = []
     for col in cols:
+        i = line_index.get(col.line)
+        m = _RESULT_VAR_RE.match(col.line)
         if col.async_start:
-            i = line_index.get(col.line)
-            m = _RESULT_VAR_RE.match(col.line)
             if i is not None and m and _async_has_compute_between(
                     lines, i, col.kind, m.group(1)):
                 continue   # genuinely overlapped: stays silent
+        elif i is not None and m and _sync_collective_hidden(
+                lines, spans, i, m.group(1)):
+            continue       # dataflow already permits overlap: silent
         unhidden.append(col)
     if not unhidden:
         return []

@@ -119,6 +119,52 @@ def allreduce(tensor, average=None, name=None, op=None):
     return from_numpy_like(out, tensor)
 
 
+def allreduce_async(tensor, average=None, name=None, op=None):
+    """Allreduce dispatched to the engine's background thread: returns
+    an :class:`~sparkdl_tpu.hvd._collectives.AsyncCollective` handle
+    immediately, so the wire time overlaps whatever the caller does
+    next (device compute, the next microbatch's forward). Resolve with
+    ``handle.result()`` — the reduced tensor comes back in the
+    caller's framework, exactly like :func:`allreduce`.
+
+    The canonical overlap pattern — hide the gradient allreduce of
+    microbatch *i* under the forward of microbatch *i+1*::
+
+        handle = hvd.allreduce_async(grads)     # hop starts now
+        next_logits = forward(next_batch)       # compute overlaps it
+        grads = handle.result()                 # serialized tail only
+
+    Ordering contract (see ``AsyncCollective``): every rank must
+    submit the same async sequence, and no *synchronous* gang
+    collective may interleave between a submit and its resolution.
+
+    With telemetry opted in this is the measured half of ROADMAP item
+    3's overlap arc: the collective span lands on the dispatch thread
+    (overlapped time in ``observe.perf``'s attribution), the residual
+    ``result()`` blocking on the caller's thread (serialized time) —
+    together, ``overlap_efficiency``.
+    """
+    del name
+    _state.require_initialized()
+    kind = _resolve_op(average, op)
+    eng = engine()
+    if _concrete_single_device_jax(tensor):
+        # jax.Arrays are immutable — safe to read from the dispatch
+        # thread without a copy
+        return eng.submit_async(
+            "reduce_jax", eng.reduce_jax, tensor, kind)
+    # COPY the host buffer before handing it to the dispatch thread:
+    # the canonical caller mutates its grads in place while the hop is
+    # in flight (that is the whole point), and a zero-copy view would
+    # let the reduce read a rank-dependent mix of old and new values.
+    x = np.array(to_numpy(tensor), order="C", copy=True)
+
+    def run():
+        return from_numpy_like(eng.reduce(x, kind), tensor)
+
+    return eng.submit_async("reduce", run)
+
+
 def grouped_allreduce(tensors, average=None, name=None, op=None):
     """Fused allreduce of a tensor list: one collective per dtype
     (Horovod tensor-fusion semantics) instead of one per tensor.
@@ -472,6 +518,7 @@ class Compression:
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "allreduce",
+    "allreduce_async",
     "grouped_allreduce", "allgather", "allgather_object", "broadcast",
     "broadcast_object",
     "barrier", "alltoall", "reducescatter", "Average", "Sum", "Min",

@@ -80,6 +80,53 @@ def _observed(op_name):
     return deco
 
 
+class AsyncCollective:
+    """Handle for a collective dispatched to the engine's background
+    thread (:meth:`_CollectiveEngine.submit_async`): the wire time runs
+    concurrently with whatever the caller does next — device compute,
+    the next microbatch's forward — instead of blocking the step
+    thread. Resolve with :meth:`result` (the reduced tensor) or
+    :meth:`wait`.
+
+    Telemetry: the collective's ``cat="collective"`` span is recorded
+    on the dispatch thread, which is exactly what ``observe.perf``
+    counts as *overlapped* collective time (a span on the step thread
+    is serialized time); any residual blocking inside :meth:`result`
+    is recorded as a ``<op>.wait`` collective span on the calling
+    thread — the serialized tail the overlap failed to hide. Together
+    they are the measured ``overlap_efficiency``.
+
+    Ordering contract: async collectives execute in submission order
+    on every rank (one dispatch thread per process), so gangs stay
+    aligned as long as every rank submits the same sequence. Do NOT
+    interleave a *synchronous* gang collective between a submit and
+    its resolution — the two threads would race for the interconnect
+    in rank-dependent order.
+    """
+
+    def __init__(self, future, op_name):
+        self._future = future
+        self._op = op_name
+
+    def done(self):
+        return self._future.done()
+
+    def result(self, timeout=None):
+        """The collective's result (re-raising its exception, if any).
+        Blocking time is recorded as serialized collective time on the
+        calling thread."""
+        if self._future.done():
+            return self._future.result(timeout)
+        with observe.span(self._op + ".wait", cat="collective",
+                          op=self._op, async_wait=True):
+            return self._future.result(timeout)
+
+    def wait(self, timeout=None):
+        """Block until done (discarding the value — for callers that
+        only need the barrier edge)."""
+        self.result(timeout)
+
+
 def _is_float_dtype(dtype):
     """numpy floats plus ml_dtypes extensions (bfloat16 etc.), which
     np.issubdtype does not recognize as np.floating."""
@@ -103,6 +150,32 @@ class _CollectiveEngine:
         self._mesh = None
         self._local_device = None
         self._fns = {}
+        self._async_pool = None
+
+    def _ensure_async_pool(self):
+        """ONE dispatch thread per process: async collectives execute
+        in submission order everywhere, so a gang that submits the
+        same sequence on every rank cannot deadlock itself."""
+        if self._async_pool is not None:
+            return self._async_pool
+        with self._lock:
+            if self._async_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._async_pool = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix="sparkdl-tpu-hvd-async",
+                )
+        return self._async_pool
+
+    def submit_async(self, op_name, fn, *args, **kwargs):
+        """Run ``fn`` (one of the public collective ops, or a closure
+        over one) on the background dispatch thread; returns an
+        :class:`AsyncCollective`. The op's ``@_observed`` span lands on
+        the dispatch thread — overlapped collective time in the perf
+        attribution."""
+        pool = self._ensure_async_pool()
+        return AsyncCollective(pool.submit(fn, *args, **kwargs), op_name)
 
     def _ensure_mesh(self):
         import jax
@@ -434,6 +507,14 @@ class _CollectiveEngine:
         self.reduce(np.zeros((1,), np.float32), SUM)
 
     def reset(self):
+        # Drain the dispatch pool BEFORE clearing engine state: an
+        # in-flight async collective would otherwise rebuild the old
+        # gang's mesh/compiled fns after the clear, leaving stale
+        # state for the next init.
+        with self._lock:
+            pool, self._async_pool = self._async_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         with self._lock:
             self._mesh = None
             self._local_device = None

@@ -49,7 +49,8 @@ DEFAULT_FLUSH_S = 5.0
 
 __all__ = [
     "enabled", "telemetry_dir", "metrics", "timeline",
-    "inc", "set_gauge", "observe_value", "span", "instant", "complete",
+    "inc", "set_gauge", "observe_value", "span", "host_span",
+    "instant", "complete",
     "set_sink", "flush", "start_flusher", "stop_flusher",
     "snapshot_payload", "new_run_dir", "Registry", "Timeline",
     "set_flight_recorder",
@@ -141,6 +142,18 @@ def span(name, cat="", **args):
     if not enabled():
         return _NOOP_SPAN
     return _timeline.span(name, cat=cat, **args)
+
+
+def host_span(name, **args):
+    """A ``cat="host"`` span: host-side work done on behalf of the
+    device program (io_callback/debug-callback bodies, checkpoint
+    host snapshots). This is the built-in emitter feeding the
+    ``host_callback`` component of the ``observe.perf`` step
+    attribution — wrap the Python body of a callback (or any host
+    detour inside the step window) and the time lands there instead
+    of being misread as compute. No-op (shared singleton) with
+    telemetry off, like :func:`span`."""
+    return span(name, cat="host", **args)
 
 
 def instant(name, cat="", **args):

@@ -20,8 +20,11 @@ compile/execute histograms. Three pieces close the gap:
    thread *blocks* it (serialized); one recorded on another thread
    while the step thread is not inside any instrumented wait is
    *overlapped with compute*. ``overlap_efficiency = overlapped
-   collective time / total collective time`` — 0.0 for today's
-   barrier-style ops, → 1.0 once collectives run async under compute.
+   collective time / total collective time`` — 0.0 for barrier-style
+   ops on the step thread, > 0 once collectives ride
+   ``hvd.allreduce_async``'s dispatch thread under compute (the ISSUE
+   10 overlap arc; ``tests/observe/test_overlap_gang.py`` pins the
+   ring-attention step above zero).
    Component seconds are *step-thread wall time*, so they sum to the
    step span's duration by construction (overlapped collective time is
    concurrent and reported separately).
@@ -538,6 +541,35 @@ def git_sha():
         return sha or None
     except Exception:
         return None
+
+
+def _percentile(samples, q):
+    """np.percentile's default linear interpolation, without the
+    numpy import this artifact-side module avoids."""
+    xs = sorted(float(s) for s in samples)
+    if not xs:
+        return None
+    k = (len(xs) - 1) * q / 100.0
+    f, c = int(k), min(int(k) + 1, len(xs) - 1)
+    return xs[f] + (xs[c] - xs[f]) * (k - f)
+
+
+def sample_metric(samples, *, unit, higher_is_better=False, digits=4):
+    """ONE ledger metric dict from raw per-rep samples (already in the
+    target unit): ``value`` = p50, with ``p99`` and the samples
+    preserved so :mod:`sparkdl_tpu.observe.compare`'s median/IQR noise
+    protection applies. The single definition of the shape
+    :func:`history_record` documents — benchmarks must not hand-roll
+    copies of it."""
+    if not samples:
+        raise ValueError("sample_metric needs at least one sample")
+    p50 = round(_percentile(samples, 50), digits)
+    return {
+        "value": p50, "p50": p50,
+        "p99": round(_percentile(samples, 99), digits),
+        "samples": [round(float(s), digits) for s in samples],
+        "unit": unit, "higher_is_better": higher_is_better,
+    }
 
 
 def history_record(metrics, *, device_kind=None, bench=None, extra=None):

@@ -154,17 +154,27 @@ class TrainCheckpointer:
 
         if not should_save():
             return False
-        # Span covers snapshot + (sync mode) the durable write; async
-        # saves show only the snapshot cost here — the overlap is the
-        # feature. Counter + duration histogram feed the alertable
-        # view (a checkpoint stall is a classic silent gang killer).
-        with observe.span("checkpoint.save", cat="checkpoint",
-                          step=int(step), sync=not self._async):
-            t0 = time.perf_counter()
-            saved = self._mgr.save(
-                step, args=ocp.args.StandardSave(state), force=force
-            )
-            if not self._async:
+        t0 = time.perf_counter()
+        if self._async:
+            # An async save() returns once the state is snapshotted to
+            # host memory — a host-side detour inside the step window,
+            # so it is attributed as ``cat="host"`` (the perf
+            # report's host_callback component) rather than claiming
+            # the background write's dispatch as checkpoint wait.
+            with observe.host_span("checkpoint.snapshot",
+                                   step=int(step)):
+                saved = self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force
+                )
+        else:
+            # Sync mode: the span covers snapshot + durable write.
+            # Counter + duration histogram feed the alertable view (a
+            # checkpoint stall is a classic silent gang killer).
+            with observe.span("checkpoint.save", cat="checkpoint",
+                              step=int(step), sync=True):
+                saved = self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force
+                )
                 self._mgr.wait_until_finished()
         if saved:
             observe.inc("checkpoint_saves_total")

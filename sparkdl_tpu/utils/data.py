@@ -2,55 +2,139 @@
 
 The reference has no in-tree data loader (SURVEY.md §2.1); the TPU
 framing is simple — host batches must be on-device BEFORE the step
-needs them. :func:`prefetch_to_device` double-buffers: while step N
-computes, batch N+1 is already transferring, hiding host→HBM latency
-behind compute.
+needs them. :func:`prefetch_to_device` runs host batch *production* on
+a background daemon thread feeding a bounded queue, and double-buffers
+the device transfers on the consuming thread: while step N computes,
+batch N+1 is already transferring AND batch N+2 is being produced —
+neither host production nor host→HBM latency sits between steps.
 """
 
 import collections
-import itertools
+import os
+
+PREFETCH_DEPTH_ENV = "SPARKDL_TPU_PREFETCH_DEPTH"
+
+_PREFETCH_THREAD_NAME = "sparkdl-tpu-prefetch"
+
+# producer → consumer queue message kinds
+_ITEM, _END, _ERR = "item", "end", "err"
 
 
 def prefetch_to_device(iterator, size=2, sharding=None):
-    """Wrap a host-batch iterator so device transfer overlaps compute.
+    """Wrap a host-batch iterator so both host batch production and
+    device transfer overlap compute.
 
     :param iterator: yields pytrees of numpy arrays.
-    :param size: buffer depth (2 = classic double buffering).
+    :param size: device-side buffer depth (2 = classic double
+        buffering). Also the default bound of the host-side producer
+        queue; ``SPARKDL_TPU_PREFETCH_DEPTH`` overrides the queue
+        bound alone (deeper host read-ahead for spiky producers).
     :param sharding: optional ``jax.sharding.Sharding`` (or pytree of
         them) for multi-chip placement; default = default device.
 
-    With telemetry opted in, each refill (host batch production +
-    dispatch of its device transfer) is a ``data.wait`` span on the
-    consuming thread. In the canonical ``for batch in
-    prefetch_to_device(...): stepped(batch)`` pattern these spans
-    fall BETWEEN the instrumented step windows, so a starved pipeline
-    surfaces as ``inter_step_data_wait_s`` in the ``observe.perf``
-    attribution report (the per-step ``data_wait`` component only
-    catches iterators consumed *inside* the step function). A
-    well-fed pipeline shows near-zero wait either way.
+    **Truly-background production**: ``next(iterator)`` runs on a
+    daemon producer thread (named ``sparkdl-tpu-prefetch``) into a
+    bounded queue, so host batch production time is hidden even in the
+    canonical ``for batch in prefetch_to_device(...): stepped(batch)``
+    pattern — the consuming thread only dequeues and dispatches the
+    (async) ``device_put``, keeping every transfer's dispatch order
+    identical to the old synchronous refill. A producer exception is
+    re-raised at the consumption point of the batch that failed, after
+    the batches produced before it have been delivered. Closing the
+    generator (``break`` + GC, or an explicit ``.close()``) stops and
+    joins the producer thread and closes the underlying iterator — an
+    abandoned pipeline leaves no live state behind.
+
+    With telemetry opted in, each refill *wait* (the dequeue + the
+    transfer dispatch) is a ``data.wait`` span on the consuming
+    thread; these fall BETWEEN the instrumented step windows, so a
+    starved pipeline (producer slower than the step) still surfaces
+    as ``inter_step_data_wait_s`` in the ``observe.perf`` attribution
+    report. A well-fed pipeline now shows near-zero wait even when
+    producing a batch is slow — that cost moved off the consuming
+    thread entirely.
     """
+    import queue as queue_mod
+    import threading
+
     import jax
 
     from sparkdl_tpu import observe
 
-    queue = collections.deque()
+    depth = int(os.environ.get(PREFETCH_DEPTH_ENV, 0) or 0) or size
+    hostq = queue_mod.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
 
-    def put(batch):
-        if sharding is None:
-            queue.append(jax.device_put(batch))
+    def produce():
+        def put(msg):
+            # bounded-blocking put that stays responsive to close():
+            # a consumer gone away must not wedge this thread forever
+            while not stop.is_set():
+                try:
+                    hostq.put(msg, timeout=0.05)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        try:
+            for batch in iterator:
+                if not put((_ITEM, batch)):
+                    return
+            put((_END, None))
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            put((_ERR, e))
+
+    thread = threading.Thread(
+        target=produce, name=_PREFETCH_THREAD_NAME, daemon=True
+    )
+
+    devq = collections.deque()
+    state = {"live": True, "err": None}
+
+    def refill():
+        """Move one produced host batch into the device buffer
+        (dispatching its async transfer); flips ``live`` at end/error."""
+        kind, val = hostq.get()
+        if kind == _END:
+            state["live"] = False
+        elif kind == _ERR:
+            state["live"] = False
+            state["err"] = val
+        elif sharding is None:
+            devq.append(jax.device_put(val))
         else:
-            queue.append(jax.device_put(batch, sharding))
+            devq.append(jax.device_put(val, sharding))
 
-    with observe.span("data.wait", cat="data", phase="prime"):
-        for batch in itertools.islice(iterator, size):
-            put(batch)
-    it = iterator
-    while queue:
-        out = queue.popleft()
-        with observe.span("data.wait", cat="data"):
-            for batch in itertools.islice(it, 1):
-                put(batch)
-        yield out
+    def close():
+        stop.set()
+        thread.join(timeout=5.0)
+        it_close = getattr(iterator, "close", None)
+        if callable(it_close):
+            try:
+                it_close()
+            except ValueError:
+                # a generator source still executing inside a wedged
+                # producer refuses close(); the daemon thread drops it
+                pass
+
+    thread.start()
+    try:
+        with observe.span("data.wait", cat="data", phase="prime"):
+            for _ in range(size):
+                if not state["live"]:
+                    break
+                refill()
+        while devq:
+            out = devq.popleft()
+            if state["live"]:
+                with observe.span("data.wait", cat="data"):
+                    refill()
+            yield out
+        if state["err"] is not None:
+            raise state["err"]
+    finally:
+        close()
 
 
 def shard_for_rank(arrays, rank=None, size=None, *, drop_last=True):

@@ -780,6 +780,32 @@ ENTRY %main {
 """
 
 
+# Sync while-body hop corpus pair (ISSUE 10): the serialized ring hop
+# feeds this iteration's kernel (bad); the double-buffered hop's result
+# only rides the back-edge tuple while independent compute runs (clean).
+_SYNC_SERIALIZED_HOP_HLO = """
+HloModule step
+%body (p: (f32[1024], f32[1024])) -> (f32[1024], f32[1024]) {
+  %p = (f32[1024]{0}, f32[1024]{0}) parameter(0)
+  %blk = f32[1024]{0} get-tuple-element((f32[1024]{0}, f32[1024]{0}) %p), index=0
+  %cp = f32[1024]{0} collective-permute(f32[1024]{0} %blk), source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %mm = f32[1024]{0} fusion(f32[1024]{0} %cp), kind=kLoop, calls=%attend
+  ROOT %t = (f32[1024]{0}, f32[1024]{0}) tuple(f32[1024]{0} %cp, f32[1024]{0} %mm)
+}
+"""
+
+_SYNC_OVERLAPPED_HOP_HLO = """
+HloModule step
+%body (p: (f32[1024], f32[1024])) -> (f32[1024], f32[1024]) {
+  %p = (f32[1024]{0}, f32[1024]{0}) parameter(0)
+  %blk = f32[1024]{0} get-tuple-element((f32[1024]{0}, f32[1024]{0}) %p), index=0
+  %cp = f32[1024]{0} collective-permute(f32[1024]{0} %blk), source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %mm = f32[1024]{0} fusion(f32[1024]{0} %blk), kind=kLoop, calls=%attend
+  ROOT %t = (f32[1024]{0}, f32[1024]{0}) tuple(f32[1024]{0} %cp, f32[1024]{0} %mm)
+}
+"""
+
+
 class TestUnoverlappedCollective:
     @staticmethod
     def _run(hlo):
@@ -818,3 +844,61 @@ class TestUnoverlappedCollective:
     def test_no_collectives_no_findings(self):
         assert self._run("ENTRY %main { ROOT %r = f32[4]{0} "
                          "parameter(0)\n}") == []
+
+    def test_serialized_while_body_hop_reported(self):
+        """A sync hop whose result feeds this iteration's kernel sits
+        on the critical path — reported even though it lives in a
+        while body full of compute (the pre-overlap ring shape)."""
+        findings = self._run(_SYNC_SERIALIZED_HOP_HLO)
+        assert findings, "serialized ring hop not reported"
+        assert findings[1].op == "collective-permute"
+        assert "barrier-style (sync)" in findings[1].message
+
+    def test_double_buffered_hop_is_silent(self):
+        """The overlapped lowering's hop — result only rides the
+        back-edge tuple, an independent kernel runs in the same body —
+        is schedulable under that compute and stays silent (the
+        double-buffered ring/pipeline shape)."""
+        assert self._run(_SYNC_OVERLAPPED_HOP_HLO) == []
+
+    def test_serialized_hop_reported_in_sigilless_hlo(self):
+        """The modern printer drops the % sigils; operand extraction
+        must still see the dataflow or a serialized hop would be
+        silenced (give-up paths must report, never silence)."""
+        findings = self._run(_SYNC_SERIALIZED_HOP_HLO.replace("%", ""))
+        assert findings, "sigil-less serialized hop not reported"
+        assert findings[1].op == "collective-permute"
+        # and the clean shape stays clean without sigils too
+        assert self._run(_SYNC_OVERLAPPED_HOP_HLO.replace("%", "")) == []
+
+    def test_collective_gating_a_while_loop_reported(self):
+        """A collective whose result rides a while loop's INIT tuple
+        gates the loop — the loop body is compute, but it cannot
+        start until the wire is done, so 'hide under the while' is
+        not available (descendant compute never counts)."""
+        hlo = """
+HloModule step
+ENTRY %main {
+  %p0 = f32[1024]{0} parameter(0)
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  %t = (f32[1024]{0}) tuple(f32[1024]{0} %ar)
+  ROOT %w = (f32[1024]{0}) while((f32[1024]{0}) %t), condition=%cond, body=%body
+}
+"""
+        findings = self._run(hlo)
+        assert findings and findings[1].op == "all-reduce"
+
+    def test_hop_feeding_compute_through_interior_tuple_reported(self):
+        """A result packaged into a NON-root tuple that feeds a
+        conditional (the cond-skipped ring hop) is still consumed this
+        iteration — interior tuples are followed, only the back edge
+        defers."""
+        hlo = _SYNC_SERIALIZED_HOP_HLO.replace(
+            "%mm = f32[1024]{0} fusion(f32[1024]{0} %cp), "
+            "kind=kLoop, calls=%attend",
+            "%arg = (f32[1024]{0}) tuple(f32[1024]{0} %cp)\n"
+            "  %mm = f32[1024]{0} conditional((f32[1024]{0}) %arg), "
+            "true_computation=%live, false_computation=%dead",
+        )
+        findings = self._run(hlo)
+        assert findings and findings[1].op == "collective-permute"

@@ -220,3 +220,52 @@ def test_check_synchronized_nan_and_tolerance_modes():
     assert HorovodRunner(np=-2).run(main) == [
         "tol-ok", "nan-caught", "same-nan-ok"
     ]
+
+
+def test_checkpoint_spans_feed_the_right_attribution_components(
+        tmp_path, monkeypatch):
+    """ISSUE 10 satellite: the built-in ``cat="host"`` emitter. A sync
+    save is checkpoint wait (``checkpoint.save``, cat="checkpoint");
+    an async save's host-memory snapshot is a host detour
+    (``checkpoint.snapshot`` via ``observe.host_span``) — feeding the
+    perf report's host_callback component from in-tree code instead of
+    "no built-in emitter yet"."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu import observe
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path / "t"))
+    observe._reset_for_tests()
+    try:
+        state = {"w": jnp.ones((2,))}
+        sync = TrainCheckpointer(str(tmp_path / "sync"))
+        try:
+            sync.save(0, state)
+        finally:
+            sync.close()
+        a = TrainCheckpointer(str(tmp_path / "async"), async_save=True)
+        try:
+            a.save(0, state)
+            a.wait_until_finished()
+        finally:
+            a.close()
+        evs = observe.timeline().drain()
+        by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+        assert by_name["checkpoint.save"]["cat"] == "checkpoint"
+        assert by_name["checkpoint.snapshot"]["cat"] == "host"
+        # never both for one save: nested cross-category spans would
+        # break the components-sum-to-step-duration contract
+        assert sum(e["name"] == "checkpoint.save" for e in evs) == 1
+        assert sum(e["name"] == "checkpoint.snapshot" for e in evs) == 1
+    finally:
+        observe._reset_for_tests()
+
+
+def test_observe_host_span_is_noop_when_disabled():
+    from sparkdl_tpu import observe
+
+    assert not observe.enabled()
+    with observe.host_span("user.callback", step=1):
+        pass
+    assert len(observe.timeline()) == 0

@@ -1,6 +1,17 @@
 """Input-pipeline tests."""
 
+import threading
+import time
+
 import numpy as np
+import pytest
+
+
+def _prefetch_threads():
+    from sparkdl_tpu.utils.data import _PREFETCH_THREAD_NAME
+
+    return [t for t in threading.enumerate()
+            if t.name == _PREFETCH_THREAD_NAME and t.is_alive()]
 
 
 def test_batched_and_prefetch_roundtrip():
@@ -61,3 +72,151 @@ def test_prefetch_with_sharding():
         batched(data, 16), sharding=sharding
     )
     assert len(batch["x"].sharding.device_set) == 8
+
+
+class TestBackgroundPrefetch:
+    """The producer-thread prefetch contract (ISSUE 10): production
+    runs on a daemon thread behind a bounded queue, ordering and
+    device_put dispatch stay on the consuming thread, producer errors
+    surface at the consumption point, and close/GeneratorExit joins
+    the thread and closes the source iterator."""
+
+    def test_production_runs_on_background_thread(self):
+        from sparkdl_tpu.utils.data import (
+            _PREFETCH_THREAD_NAME, prefetch_to_device,
+        )
+
+        seen = []
+
+        def gen():
+            for i in range(4):
+                seen.append(threading.current_thread().name)
+                yield {"x": np.full((2,), i, np.float32)}
+
+        batches = list(prefetch_to_device(gen(), size=2))
+        assert len(batches) == 4
+        assert set(seen) == {_PREFETCH_THREAD_NAME}
+        assert _prefetch_threads() == []
+
+    def test_ordering_preserved(self):
+        from sparkdl_tpu.utils.data import prefetch_to_device
+
+        def gen():
+            for i in range(7):
+                yield {"x": np.full((3,), i, np.float32)}
+
+        out = [int(np.asarray(b["x"])[0])
+               for b in prefetch_to_device(gen(), size=3)]
+        assert out == list(range(7))
+
+    def test_queue_depth_bounds_readahead(self, monkeypatch):
+        """The producer must not run unboundedly ahead: after consuming
+        one batch, at most consumed + size + depth + 1 batches have
+        ever been pulled (device buffer + host queue + the one in the
+        producer's hand)."""
+        from sparkdl_tpu.utils import data as data_mod
+
+        monkeypatch.setenv(data_mod.PREFETCH_DEPTH_ENV, "2")
+        pulled = []
+
+        def gen():
+            for i in range(100):
+                pulled.append(i)
+                yield {"x": np.zeros((1,), np.float32)}
+
+        pf = data_mod.prefetch_to_device(gen(), size=2)
+        try:
+            next(pf)
+            time.sleep(0.3)  # rope for an unbounded producer to hang itself
+            assert len(pulled) <= 1 + 2 + 2 + 1, pulled
+        finally:
+            pf.close()
+        assert _prefetch_threads() == []
+
+    def test_producer_exception_raised_at_consumption_point(self):
+        """Batches produced before the failure are delivered; the
+        error surfaces where the failed batch would have been."""
+        from sparkdl_tpu.utils.data import prefetch_to_device
+
+        def gen():
+            yield {"x": np.zeros((1,), np.float32)}
+            yield {"x": np.ones((1,), np.float32)}
+            raise RuntimeError("disk on fire")
+
+        pf = prefetch_to_device(gen(), size=2)
+        got = []
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            for b in pf:
+                got.append(float(np.asarray(b["x"])[0]))
+        assert got == [0.0, 1.0]
+        assert _prefetch_threads() == []
+
+    def test_break_joins_thread_and_closes_iterator(self):
+        """ISSUE 10 satellite: an early consumer break must leave no
+        live state — producer thread joined, source iterator closed
+        (the old implementation leaked both)."""
+        from sparkdl_tpu.utils.data import prefetch_to_device
+
+        closed = {"flag": False}
+
+        def gen():
+            try:
+                i = 0
+                while True:
+                    yield {"x": np.full((1,), i, np.float32)}
+                    i += 1
+            finally:
+                closed["flag"] = True
+
+        pf = prefetch_to_device(gen(), size=2)
+        for _ in pf:
+            break
+        pf.close()
+        assert closed["flag"], "underlying iterator leaked"
+        assert _prefetch_threads() == []
+
+    def test_close_is_safe_started_or_not(self):
+        from sparkdl_tpu.utils.data import prefetch_to_device
+
+        # never started: the generator body (and thread) never ran
+        pf = prefetch_to_device(iter([{"x": np.zeros((1,))}]), size=2)
+        pf.close()
+        assert _prefetch_threads() == []
+        # started but unconsumed past the first batch: the live
+        # producer thread must be joined by close()
+        pf = prefetch_to_device(
+            ({"x": np.full((1,), i, np.float32)} for i in range(50)),
+            size=2)
+        next(pf)
+        assert _prefetch_threads(), "producer thread never started"
+        pf.close()
+        assert _prefetch_threads() == []
+
+    def test_starved_pipeline_still_emits_data_wait_spans(
+            self, monkeypatch, tmp_path):
+        """The data.wait span contract survives the producer thread: a
+        slow producer's starvation is visible on the CONSUMING thread
+        (feeding inter_step_data_wait_s), with the priming span still
+        phase="prime"."""
+        from sparkdl_tpu import observe
+        from sparkdl_tpu.utils.data import prefetch_to_device
+
+        monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+        observe._reset_for_tests()
+        try:
+            def slow_gen():
+                for i in range(3):
+                    time.sleep(0.05)
+                    yield {"x": np.full((1,), i, np.float32)}
+
+            list(prefetch_to_device(slow_gen(), size=1))
+            evs = observe.timeline().drain()
+            waits = [e for e in evs if e["name"] == "data.wait"]
+            assert waits, "no data.wait spans emitted"
+            assert waits[0]["args"].get("phase") == "prime"
+            me = threading.get_ident() & 0x7FFFFFFF
+            assert all(e["tid"] == me for e in waits)
+            # a starved pipeline shows real wait time on the consumer
+            assert sum(e["dur"] for e in waits) > 20_000  # µs
+        finally:
+            observe._reset_for_tests()
