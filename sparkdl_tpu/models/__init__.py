@@ -11,6 +11,9 @@ decode-only machinery):
 - :mod:`.serving` — ContinuousBatchingEngine / SpeculativeBatchingEngine
   (paged cache, prefix caching, multi-LoRA, stops, logprobs)
 - :mod:`.server` — HTTP front-end over any engine
+- :mod:`.fleet` — N engine replicas behind one admission-controlled
+  frontend (bounded-queue 503s, least-depth routing, replica
+  supervision/respawn)
 - :mod:`.speculative` — single-burst speculative decode + the
   rejection-sampling core
 - :mod:`.quant` — int8/int4 weight-only serving conversions

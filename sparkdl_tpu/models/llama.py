@@ -70,6 +70,11 @@ class LlamaConfig:
     quant: str = ""               # "" (dense) | "int8" | "int4" weight-only
                                   # serving (params from
                                   # models.quant.quantize_llama_params)
+    # int4 group size (rows per scale). Must match the checkpoint's
+    # quantize group: flax pins param shapes, so the scale tree's
+    # (K//group, N) layout is part of the serving config, not a
+    # runtime inference.
+    quant_group: int = 64
     # Multi-LoRA serving: > 0 stacks that many adapters on the frozen
     # base (params from models.lora.stack_lora_adapters); adapter_ids
     # passed to __call__ select one per batch row (S-LoRA-style
@@ -158,7 +163,7 @@ def _dense(cfg, features, name):
 
         if cfg.quant == "int4":
             return QuantDense4(features=features, dtype=cfg.dtype,
-                               name=name)
+                               group=cfg.quant_group, name=name)
         return QuantDense(features=features, dtype=cfg.dtype, name=name)
     if cfg.lora_rank and name in cfg.lora_targets:
         return LoRADense(features=features, rank=cfg.lora_rank,
@@ -550,9 +555,13 @@ class Llama(nn.Module):
         if cfg.quant:
             from sparkdl_tpu.models.quant import QuantDense, QuantDense4
 
-            head = QuantDense4 if cfg.quant == "int4" else QuantDense
-            return head(cfg.vocab_size, dtype=jnp.float32,
-                        name="lm_head")(x.astype(jnp.float32))
+            if cfg.quant == "int4":
+                return QuantDense4(cfg.vocab_size, dtype=jnp.float32,
+                                   group=cfg.quant_group,
+                                   name="lm_head")(
+                    x.astype(jnp.float32))
+            return QuantDense(cfg.vocab_size, dtype=jnp.float32,
+                              name="lm_head")(x.astype(jnp.float32))
         # fp32 head: stability for the softmax/sampling path. (A bf16
         # head was measured on v5e and did NOT beat this — XLA already
         # runs the fp32 matmul as bf16x3 passes and the extra output

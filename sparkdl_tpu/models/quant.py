@@ -86,9 +86,11 @@ class QuantDense4(nn.Module):
         )
         lead = x.shape[:-1]
         flat = x.reshape((-1, d_in)).astype(self.dtype)
-        # group inferred from the CHECKPOINT's scale shape (like
-        # dequantize_params): self.group only sizes fresh init — a
-        # tree quantized at a different group must still serve
+        # the runtime group still comes from the scale shape (the one
+        # source of truth for dequant), but self.group must MATCH the
+        # checkpoint's quantize group — flax pins param shapes, so a
+        # different-group tree needs the module (or
+        # LlamaConfig.quant_group) constructed to match
         out = quantized_matmul_int4(
             flat, w_q, scale, group=d_in // scale.shape[0])
         return out.reshape(lead + (self.features,)).astype(self.dtype)

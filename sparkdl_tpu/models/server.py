@@ -84,6 +84,105 @@ def _status_safe(message):
     return message.encode("latin-1", "replace").decode("latin-1")
 
 
+# -- wire plumbing shared with the multi-replica fleet frontend -------------
+# (models/fleet.py serves the SAME /generate contract through these, so
+# the two frontends cannot drift on validation or delivery semantics)
+
+
+def parse_generate(raw, engine):
+    """Parse + validate one ``/generate`` body against ``engine``'s
+    capacity contract. Returns ``(req, parsed)``; raises ``KeyError`` /
+    ``TypeError`` / ``ValueError`` / ``json.JSONDecodeError`` on
+    anything a 400 should answer. ONE definition of request validation
+    for every frontend — the streamed and blocking paths (and every
+    replica of a fleet) must reject the same inputs the same way."""
+    req = json.loads(raw)
+    parsed = {
+        "tokens": [int(t) for t in req["tokens"]],
+        "max_new_tokens": int(req.get("max_new_tokens", 32)),
+        "stop": req.get("stop"),
+    }
+    if parsed["max_new_tokens"] < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    worst = engine._worst_case_tokens(
+        len(parsed["tokens"]), parsed["max_new_tokens"])
+    if worst > engine.cfg.max_cache_len:
+        raise ValueError(
+            f"prompt + budget ({worst}) exceeds max_cache_len "
+            f"({engine.cfg.max_cache_len})")
+    return req, parsed
+
+
+def send_json(handler, code, obj):
+    """One JSON response with correct framing."""
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def deliver_blocking(handler, box, record):
+    """Answer a non-streamed request from its finished mailbox.
+    ``record(outcome, t0)`` accounts the response (outcome = HTTP code
+    or "disconnect")."""
+    if box.error is not None:
+        # 400 = the request's fault, 500 = the engine's/replica's,
+        # 503 = lifecycle (see _Mailbox.fail) — clients and load
+        # balancers must be able to tell bad input from a sick server.
+        record(box.error_code, box.t0)
+        handler.send_error(box.error_code, box.error)
+        return
+    # Count 200 only once the body is DELIVERED — a client hanging up
+    # mid-write records "disconnect", matching the streaming path's
+    # accounting.
+    outcome = "disconnect"
+    try:
+        toks, reason, lps = box.result
+        send_json(handler, 200, {
+            "tokens": [int(t) for t in toks],
+            "finish_reason": reason,
+            "logprobs": [float(v) for v in lps],
+        })
+        outcome = 200
+    finally:
+        record(outcome, box.t0)
+
+
+def deliver_stream(handler, box, record):
+    """Drain a mailbox's token stream to the client as SSE. The 200
+    commits up front; the metric records the request's real OUTCOME
+    class instead — a 500 that rode a terminal error event counts as
+    500, and a client that hung up mid-stream counts as "disconnect"
+    (the recording rides a finally: a broken pipe must not silently
+    drop the request from server_requests_total)."""
+    outcome = "disconnect"
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.end_headers()
+        while True:
+            tok = box.tokens.get()
+            if tok is None:          # engine says done
+                break
+            handler.wfile.write(
+                b"data: " + json.dumps({"token": tok}).encode()
+                + b"\n\n")
+            handler.wfile.flush()
+        if box.error is not None:
+            tail = {"error": box.error}
+        else:
+            tail = {"done": box.result[1]}
+        handler.wfile.write(
+            b"data: " + json.dumps(tail).encode() + b"\n\n")
+        handler.wfile.flush()
+        # tail delivered: the stream truly completed
+        outcome = box.error_code if box.error is not None else 200
+    finally:
+        record(outcome, box.t0)
+
+
 class _Mailbox:
     """Per-request rendezvous between the engine thread and one HTTP
     handler thread: a token stream and a final-result event."""
@@ -214,22 +313,8 @@ class ServingFrontend:
                 # engine could complain).
                 try:
                     n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    parsed = {
-                        "tokens": [int(t) for t in req["tokens"]],
-                        "max_new_tokens": int(
-                            req.get("max_new_tokens", 32)),
-                        "stop": req.get("stop"),
-                    }
-                    if parsed["max_new_tokens"] < 1:
-                        raise ValueError("max_new_tokens must be >= 1")
-                    worst = frontend.engine._worst_case_tokens(
-                        len(parsed["tokens"]), parsed["max_new_tokens"])
-                    if worst > frontend.engine.cfg.max_cache_len:
-                        raise ValueError(
-                            f"prompt + budget ({worst}) exceeds "
-                            f"max_cache_len "
-                            f"({frontend.engine.cfg.max_cache_len})")
+                    req, parsed = parse_generate(
+                        self.rfile.read(n), frontend.engine)
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
                     rt = frontend.request_telemetry
@@ -254,72 +339,10 @@ class ServingFrontend:
                     self._respond(box)
 
             def _respond(self, box):
-                if box.error is not None:
-                    # 400 = the request's fault, 500 = the engine's,
-                    # 503 = lifecycle (see _Mailbox.fail) — clients
-                    # and load balancers must be able to tell bad
-                    # input from a sick server.
-                    frontend._record_request(box.error_code, box.t0)
-                    self.send_error(box.error_code, box.error)
-                    return
-                # Count 200 only once the body is DELIVERED — a client
-                # hanging up mid-write records "disconnect", matching
-                # the streaming path's accounting.
-                outcome = "disconnect"
-                try:
-                    toks, reason, lps = box.result
-                    body = json.dumps({
-                        "tokens": [int(t) for t in toks],
-                        "finish_reason": reason,
-                        "logprobs": [float(v) for v in lps],
-                    }).encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "application/json")
-                    self.send_header(
-                        "Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    outcome = 200
-                finally:
-                    frontend._record_request(outcome, box.t0)
+                deliver_blocking(self, box, frontend._record_request)
 
             def _stream(self, box):
-                # SSE commits 200 on the wire up front; the metric
-                # records the request's real OUTCOME class instead —
-                # a 500 that rode a terminal error event counts as
-                # 500, and a client that hung up mid-stream counts as
-                # "disconnect" (the recording rides a finally: a
-                # broken pipe must not silently drop the request from
-                # server_requests_total while its first-token latency
-                # was already observed).
-                outcome = "disconnect"
-                try:
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/event-stream")
-                    self.end_headers()
-                    while True:
-                        tok = box.tokens.get()
-                        if tok is None:          # engine says done
-                            break
-                        self.wfile.write(
-                            b"data: "
-                            + json.dumps({"token": tok}).encode()
-                            + b"\n\n")
-                        self.wfile.flush()
-                    if box.error is not None:
-                        tail = {"error": box.error}
-                    else:
-                        tail = {"done": box.result[1]}
-                    self.wfile.write(
-                        b"data: " + json.dumps(tail).encode() + b"\n\n")
-                    self.wfile.flush()
-                    # tail delivered: the stream truly completed
-                    outcome = (box.error_code if box.error is not None
-                               else 200)
-                finally:
-                    frontend._record_request(outcome, box.t0)
+                deliver_stream(self, box, frontend._record_request)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address

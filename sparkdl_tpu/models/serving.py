@@ -236,12 +236,23 @@ class ContinuousBatchingEngine:
     def __init__(self, model, params, *, n_slots=4, temperature=0.0,
                  eos_id=None, chunk=16, rng=None, mesh=None,
                  rules=None, page_size=0, n_pages=None,
-                 prefill_chunk=0, top_k=0, top_p=1.0):
+                 prefill_chunk=0, top_k=0, top_p=1.0, quant=""):
         """``mesh`` enables tensor-parallel serving: params are placed
         per ``rules`` (default TRANSFORMER_RULES — Megatron column/row
         splits) and the KV cache is sharded over its kv-heads axis on
         the ``model`` mesh axis; GSPMD inserts the collectives in the
         same jitted programs the single-device engine runs.
+
+        ``quant`` ("int8" | "int4") selects weight-only quantized
+        serving PER ENGINE: the dense ``params`` tree is quantized at
+        construction (models.quant.quantize_llama_params) and every
+        decode matmul runs through QuantDense/QuantDense4 — one fleet
+        can mix bf16 and int8 replicas off the same checkpoint.
+        Composes with ``mesh``: the sharding rules match the
+        ``kernel_q``/``kernel_q4`` leaves through the same Megatron
+        patterns as dense kernels (scales replicate). Pass a tree
+        that is ALREADY quantized (cfg.quant set on ``model``) with
+        ``quant=""`` — quantizing twice is refused.
 
         ``page_size`` > 0 switches to a PAGED KV cache: one pooled
         physical store of ``n_pages`` pages shared by every slot
@@ -258,6 +269,26 @@ class ContinuousBatchingEngine:
         (Sarathi-style), bounding the decode stall a long admission
         causes to one segment instead of the whole prompt."""
         cfg = model.cfg
+        if quant:
+            if quant not in ("int8", "int4"):
+                raise ValueError(
+                    f"unknown quant mode {quant!r}; expected 'int8' "
+                    "or 'int4'"
+                )
+            if cfg.quant:
+                raise ValueError(
+                    f"model is already quantized (cfg.quant="
+                    f"{cfg.quant!r}); pass quant= only with a dense "
+                    "tree"
+                )
+            from sparkdl_tpu.models.quant import quantize_llama_params
+
+            # replace() re-runs __post_init__, which enforces the
+            # quant/LoRA/multi-adapter exclusivity rules
+            cfg = dataclasses.replace(cfg, quant=quant)
+            params = quantize_llama_params(
+                params, bits=8 if quant == "int8" else 4,
+                group=cfg.quant_group)
         self.page_size = int(page_size)
         self.prefill_chunk = int(prefill_chunk)
         if self.prefill_chunk < 0:

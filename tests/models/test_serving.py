@@ -122,6 +122,66 @@ def test_engine_with_int8_weights(setup):
     )
 
 
+def test_engine_quant_kwarg_matches_prequantized_tree(setup):
+    """Per-engine int8 selection (quant="int8") must serve EXACTLY
+    what an engine handed the pre-quantized tree + quantized model
+    serves — the kwarg is sugar over quantize_llama_params, not a
+    second quantization path."""
+    import dataclasses
+
+    from sparkdl_tpu.models.quant import quantize_llama_params
+
+    cfg, model, params = setup
+    rng = np.random.default_rng(11)
+    p = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+
+    eng = ContinuousBatchingEngine(model, params, n_slots=2, chunk=4,
+                                   quant="int8")
+    rid = eng.submit(p, 6)
+    got = eng.run()[rid]
+
+    model_q = Llama(dataclasses.replace(cfg, quant="int8"))
+    q_tree = quantize_llama_params(params)
+    ref = ContinuousBatchingEngine(model_q, q_tree, n_slots=2, chunk=4)
+    rid2 = ref.submit(p, 6)
+    np.testing.assert_array_equal(got, ref.run()[rid2])
+
+    # double quantization and junk modes are refused loudly
+    with pytest.raises(ValueError, match="already quantized"):
+        ContinuousBatchingEngine(model_q, q_tree, quant="int8")
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        ContinuousBatchingEngine(model, params, quant="fp8")
+
+
+def test_engine_tp_int8_matches_single_device(setup):
+    """The two serving axes compose: an int8-quantized engine on a
+    model=2 TP mesh emits the same greedy tokens as the int8 engine
+    on one device (acceptance: TP bit-exact vs the single-device
+    lowering, quantized path included)."""
+    from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg, model, params = setup
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9)]
+
+    def run(engine):
+        rids = [engine.submit(p, b) for p, b in zip(prompts, (6, 8))]
+        res = engine.run()
+        return [res[r] for r in rids]
+
+    base = run(ContinuousBatchingEngine(model, params, n_slots=2,
+                                        chunk=4, quant="int8"))
+    tp = run(ContinuousBatchingEngine(model, params, n_slots=2,
+                                      chunk=4, quant="int8",
+                                      mesh=mesh))
+    for b, t in zip(base, tp):
+        np.testing.assert_array_equal(b, t)
+
+
 def test_engine_tensor_parallel_matches_single_device(setup):
     """TP serving over a ('data','fsdp','seq','model') mesh with
     model=2: params Megatron-sharded, KV cache sharded over kv-heads —

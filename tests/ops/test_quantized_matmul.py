@@ -170,3 +170,78 @@ class TestInt4:
         # per-group symmetric int4: error <= group scale / 2
         err_bound = np.repeat(s, 64, axis=0) / 2 + 1e-7
         assert (np.abs(deq - w) <= err_bound).all()
+
+
+class TestThreeWayEquivalence:
+    """ISSUE-11 satellite: one serving matmul, three lowerings — the
+    Pallas kernel (interpret mode on CPU), the XLA dequant-matmul
+    fallback, and the full-precision dense reference. The first two
+    must agree to float-accumulation tolerance (they compute the SAME
+    dequantized product), and both must sit within the pinned
+    quantization-error envelope of the dense reference — so a fleet
+    mixing kernel and fallback replicas answers consistently."""
+
+    def _xla_fallback_int8(self, x, w_q, s):
+        # the exact expression quantized_matmul takes when use_pallas()
+        # is false — evaluated explicitly so this test pins BOTH sides
+        # even on a machine where the dispatch would pick the kernel
+        w = jnp.asarray(w_q).astype(jnp.float32) * jnp.asarray(s)[None, :]
+        return np.asarray((x.astype(jnp.float32) @ w).astype(x.dtype))
+
+    def test_int8_interpret_vs_xla_vs_dense(self):
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(64, 128), jnp.float32)
+        w = (rng.randn(128, 256) * 0.05).astype(np.float32)
+        w_q, s = quantize_int8(w)
+        kernel = np.asarray(quantized_matmul(
+            x, jnp.asarray(w_q), jnp.asarray(s), interpret=True))
+        xla = self._xla_fallback_int8(x, w_q, s)
+        dense = np.asarray(x) @ w
+        # kernel vs fallback: same dequantized product, fp32
+        # accumulation — only summation order differs
+        np.testing.assert_allclose(kernel, xla, atol=1e-4, rtol=1e-5)
+        # both vs dense: the int8 rounding envelope, pinned
+        for q in (kernel, xla):
+            rel = (np.abs(q - dense).mean()
+                   / (np.abs(dense).mean() + 1e-9))
+            assert rel < 0.02, rel
+
+    def test_int8_bf16_activations(self):
+        """The serving dtype: bf16 activations through both lowerings
+        stay bit-identical to each other (the cast happens after the
+        fp32 accumulate on both paths)."""
+        rng = np.random.RandomState(8)
+        x = jnp.asarray(rng.randn(32, 64), jnp.bfloat16)
+        w = (rng.randn(64, 128) * 0.1).astype(np.float32)
+        w_q, s = quantize_int8(w)
+        kernel = np.asarray(quantized_matmul(
+            x, jnp.asarray(w_q), jnp.asarray(s), interpret=True
+        ).astype(jnp.float32))
+        xla = np.asarray(self._xla_fallback_int8(
+            x, w_q, s).astype(jnp.float32))
+        np.testing.assert_allclose(kernel, xla, atol=2e-2, rtol=2e-2)
+
+    def test_int4_interpret_vs_xla_vs_dense(self):
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            _dequant_int4,
+            quantize_int4,
+            quantized_matmul_int4,
+        )
+
+        rng = np.random.RandomState(9)
+        x = jnp.asarray(rng.randn(64, 128), jnp.float32)
+        w = (rng.randn(128, 128) * 0.05).astype(np.float32)
+        packed, s = quantize_int4(w, group=64)
+        kernel = np.asarray(quantized_matmul_int4(
+            x, jnp.asarray(packed), jnp.asarray(s), group=64,
+            interpret=True))
+        deq = _dequant_int4(jnp.asarray(packed), jnp.asarray(s), 64)
+        xla = np.asarray(
+            (x.astype(jnp.float32) @ deq).astype(x.dtype))
+        dense = np.asarray(x) @ w
+        np.testing.assert_allclose(kernel, xla, atol=1e-4, rtol=1e-5)
+        # int4's 15 levels with group scales: looser but PINNED
+        for q in (kernel, xla):
+            rel = (np.abs(q - dense).mean()
+                   / (np.abs(dense).mean() + 1e-9))
+            assert rel < 0.15, rel
