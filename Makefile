@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast bench bench-first native docs clean
+.PHONY: test test-fast bench bench-first native docs clean autotune autotune-plan
 
 test:
 	python -m pytest tests/ -q
@@ -21,6 +21,12 @@ bench-first:        # bench BEFORE the test suite claims the accelerator
 
 bench-all:          # every TPU artifact in one lease session
 	bash benchmarks/tpu_homecoming.sh
+
+autotune:           # search the knob space; emit the per-device-kind profile
+	python -m sparkdl_tpu.perf.autotune --bench cpu-proxy
+
+autotune-plan:      # show the (pruned) trial plan without measuring
+	python -m sparkdl_tpu.perf.autotune --bench cpu-proxy --dry-run
 
 native:             # build the C++ control-plane transport
 	$(MAKE) -C native

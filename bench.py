@@ -405,10 +405,16 @@ def run():
     opt_state = opt.init(params)
 
     # Shared builder with bench_variants: the config the sweep measured
-    # is byte-for-byte the config a promotion runs.
+    # is byte-for-byte the config a promotion runs. The loss-chunk knob
+    # is env-tunable (SPARKDL_TPU_LOSS_CHUNK — the perf.autotune
+    # microbatching axis); a committed promoted.json still wins, since
+    # a promotion is a measured decision for THIS host class.
+    from sparkdl_tpu.utils.knobs import read_int
+
     loss_fn = make_lm_loss_fn(
         model, loss=promoted.get("loss", "logits"),
-        chunk=int(promoted.get("chunk", 512)),
+        chunk=int(promoted["chunk"]) if "chunk" in promoted
+        else read_int("SPARKDL_TPU_LOSS_CHUNK", 512),
         ce_bf16=bool(promoted.get("ce_bf16")),
     )
 

@@ -484,7 +484,22 @@ def _ledger_metrics(result, suffix=""):
     return metrics
 
 
+def _env_int(name, default=None):
+    from sparkdl_tpu.utils import knobs
+
+    return knobs.read_int(name, default)
+
+
+def _knob_str(name):
+    from sparkdl_tpu.utils import knobs
+
+    return knobs.read(name) or ""
+
+
 def main(argv=None):
+    # Serving-knob env defaults (registered in sparkdl_tpu.utils.knobs;
+    # the surface an autotuned profile pins) — an explicit CLI flag
+    # always wins over the profile's env.
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--streams", type=int, default=4)
     ap.add_argument("--requests-per-stream", type=int, default=4)
@@ -492,25 +507,35 @@ def main(argv=None):
                     default="closed")
     ap.add_argument("--rate", type=float, default=8.0,
                     help="poisson arrivals/sec across the fleet")
-    ap.add_argument("--replicas", type=int, default=1,
+    ap.add_argument("--replicas", type=int,
+                    default=_env_int("SPARKDL_TPU_SERVE_REPLICAS", 1),
                     help=">1 serves through the multi-replica "
                          "FleetFrontend (admission control + routing)")
-    ap.add_argument("--max-queue", type=int, default=None,
+    ap.add_argument("--max-queue", type=int,
+                    default=_env_int("SPARKDL_TPU_SERVE_MAX_QUEUE"),
                     help="fleet admission bound (queued+in-flight); "
                          "default: 4x total slots")
     ap.add_argument("--quant", choices=("", "int8", "int4"),
-                    default="", help="weight-only quantized serving")
+                    default=_knob_str("SPARKDL_TPU_SERVE_QUANT"),
+                    help="weight-only quantized serving")
     ap.add_argument("--ab-quant", action="store_true",
                     help="run bf16 then int8 under the same load and "
                          "report the throughput delta")
     ap.add_argument("--prompt-len", type=int, default=None)
     ap.add_argument("--max-new", type=int, default=None)
     ap.add_argument("--n-slots", type=int, default=None)
-    ap.add_argument("--page-size", type=int, default=0)
+    ap.add_argument("--page-size", type=int,
+                    default=_env_int("SPARKDL_TPU_KV_PAGE_SIZE", 0))
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--no-ledger", action="store_true",
                     help="do not append to the history.jsonl ledger")
     args = ap.parse_args(argv)
+    if args.quant not in ("", "int8", "int4"):
+        # argparse validates `choices` only for explicitly passed
+        # flags — an env/profile-sourced default must face the same
+        # check instead of detonating at model build
+        ap.error(f"SPARKDL_TPU_SERVE_QUANT={args.quant!r} is not one "
+                 "of '', 'int8', 'int4'")
     if args.ab_quant and args.quant:
         # --ab-quant runs its OWN pair (bf16 then int8); silently
         # overriding --quant would label the record with a mode that
@@ -553,6 +578,8 @@ def main(argv=None):
         args.chunk = 16
         args.prompt_len = args.prompt_len or 64
         args.max_new = args.max_new or 128
+    # decode chunk rides the shape default unless the knob pins it
+    args.chunk = _env_int("SPARKDL_TPU_SERVE_DECODE_CHUNK", args.chunk)
     if args.max_queue is None:
         args.max_queue = 4 * args.n_slots * args.replicas
     model = Llama(cfg)

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""CI self-tuning-runtime smoke (ISSUE 12): prove the ledger→knobs
+loop end to end on the tiny cpu-proxy bench, in minutes.
+
+1. **Search**: ``python -m sparkdl_tpu.perf.autotune`` over a 2-knob ×
+   2-value space (``SPARKDL_TPU_LOSS_CHUNK`` ∈ {128, 512},
+   ``SPARKDL_TPU_PREFETCH_DEPTH`` ∈ {2, 4}) on the tiny cpu-proxy
+   shape. The pruner must drop the prefetch knob (the cpu-proxy's
+   static attribution is compute-bound — the headline pruning rule,
+   proven in CI, not just in unit tests), and the measured trial
+   count must stay bounded: ≤ the configuration-space size (4),
+   logged by the driver — a plan over budget refuses, it never
+   silently truncates.
+2. **Artifact**: the run must emit a schema-versioned profile JSON
+   (verified or degraded — on a noisy 2-vCPU runner "defaults win" is
+   a legitimate verdict; what the smoke enforces is the loop, not a
+   lucky speedup).
+3. **Apply**: the profile must flow through the LAUNCHER pre-flight —
+   ``sparkdl_tpu.perf.profile.preflight_env`` (the exact function
+   ``_launch_gang_once`` calls per attempt) resolves it from
+   ``SPARKDL_TPU_PERF_PROFILE`` and yields its knobs under the
+   operator env.
+4. **No-worse gate**: one fresh bench run under the applied profile
+   env vs one fresh default run must pass
+   ``observe.compare default-run profile-run`` (rc=0 — the
+   proof-or-degrade contract holds at apply time too). A degraded/
+   empty profile applies nothing, so the pair compares identical
+   configs and still proves the gate wiring.
+
+Artifacts (profile, per-trial ledger, bench JSONs, compare verdicts)
+land in the dir the workflow uploads. Outside the time-boxed tier-1
+pytest gate — its own workflow step, like the other smokes.
+
+Usage: ``python ci/autotune_smoke.py [artifacts_dir]``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIMEOUT_S = 2400
+SPACE = {
+    "SPARKDL_TPU_LOSS_CHUNK": ["128", "512"],
+    "SPARKDL_TPU_PREFETCH_DEPTH": ["2", "4"],
+}
+SPACE_SIZE = 4  # 2 knobs x 2 values
+
+
+def fail(msg):
+    print(f"AUTOTUNE SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_bench(env, out_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        fail(f"bench exited {proc.returncode}:\n{proc.stderr[-2000:]}")
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    print(f"bench: {rec['metric']} = {rec['value']} -> {out_path}")
+    return rec
+
+
+def main():
+    art = os.path.abspath(
+        sys.argv[1] if len(sys.argv) > 1 else "autotune-artifacts")
+    os.makedirs(art, exist_ok=True)
+    history = os.path.join(art, "history.jsonl")
+    profile_path = os.path.join(art, "cpu-profile.json")
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["SPARKDL_TPU_BENCH_TINY"] = "1"
+    # a profile already on this runner must not contaminate the search
+    env["SPARKDL_TPU_PERF_PROFILE"] = "off"
+
+    # 1. the search (2 knobs x 2 values, tiny shape)
+    cmd = [sys.executable, "-m", "sparkdl_tpu.perf.autotune",
+           "--bench", "cpu-proxy", "--tiny",
+           "--history", history, "--out", profile_path,
+           "--max-trials", str(SPACE_SIZE)]
+    for name, values in SPACE.items():
+        cmd += ["--knob", name, "--values", f"{name}={','.join(values)}"]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=TIMEOUT_S, cwd=ROOT)
+    sys.stderr.write(proc.stderr[-4000:])
+    print(proc.stdout)
+    if proc.returncode != 0:
+        fail(f"autotune exited {proc.returncode}")
+
+    # 2. profile artifact, schema-checked through the real loader
+    from sparkdl_tpu.perf import profile as prof
+
+    doc = prof.load_profile(profile_path)
+    if doc["status"] not in ("verified", "degraded"):
+        fail(f"unexpected profile status {doc['status']!r}")
+    print(f"profile: status={doc['status']} knobs={doc['knobs']}")
+
+    # the pruning rule, proven in CI: the compute-bound cpu-proxy
+    # attribution must have removed the data-pipeline knob
+    pruned = [p[0] for p in doc.get("evidence", {}).get("pruned", [])]
+    if "SPARKDL_TPU_PREFETCH_DEPTH" not in pruned:
+        fail(f"prefetch depth was not pruned (pruned={pruned}) — the "
+             "attribution pruning contract is broken")
+    print(f"pruned: {pruned}")
+
+    # bounded, logged trial count: greedy search trials <= space size
+    trials = doc.get("evidence", {}).get("trials")
+    if trials is None:
+        fail("profile evidence carries no trial log")
+    n_search = 1 + len(trials)   # baseline + logged candidates
+    if n_search > SPACE_SIZE:
+        fail(f"search measured {n_search} trials > space size "
+             f"{SPACE_SIZE} — the bound is not real")
+    print(f"search trials: {n_search} (space size {SPACE_SIZE})")
+    ledger_lines = sum(1 for ln in open(history) if ln.strip())
+    print(f"ledger lines appended: {ledger_lines}")
+    if ledger_lines < n_search:
+        fail(f"only {ledger_lines} ledger lines for {n_search} trials "
+             "— trials are not landing in history.jsonl")
+
+    # 3. apply through the launcher pre-flight (the same function
+    # _launch_gang_once calls), profile selected via the env knob
+    apply_env = dict(env)
+    apply_env["SPARKDL_TPU_PERF_PROFILE"] = profile_path
+    for name in SPACE:
+        apply_env.pop(name, None)   # operator leaves knobs unset
+    code = (
+        "import json, os\n"
+        "from sparkdl_tpu.perf.profile import preflight_env\n"
+        "print(json.dumps(preflight_env(os.environ)))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=apply_env,
+                         capture_output=True, text=True, timeout=120,
+                         cwd=ROOT)
+    if out.returncode != 0:
+        fail(f"preflight_env failed: {out.stderr[-1000:]}")
+    delta = json.loads(out.stdout.strip().splitlines()[-1])
+    print(f"launcher pre-flight applies: {delta}")
+    expected = doc["knobs"] if doc["status"] == "verified" else {}
+    if delta != expected:
+        fail(f"pre-flight delta {delta} != profile knobs {expected}")
+    with open(os.path.join(art, "preflight-applied.json"), "w") as f:
+        json.dump(delta, f, indent=2)
+
+    # 4. no-worse gate: default run vs profile-applied run
+    default_env = dict(env)
+    default_env["SPARKDL_TPU_PERF_HISTORY"] = history
+    profile_run_env = dict(default_env)
+    profile_run_env.update(delta)
+    default_json = os.path.join(art, "default-run.json")
+    profile_json = os.path.join(art, "profile-run.json")
+    run_bench(default_env, default_json)
+    run_bench(profile_run_env, profile_json)
+    cmp_out = os.path.join(art, "compare-default-vs-profile.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.compare",
+         default_json, profile_json, "--format", "json"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=ROOT)
+    with open(cmp_out, "w") as f:
+        f.write(proc.stdout or proc.stderr)
+    verdict = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    print(f"compare default-run profile-run: rc={proc.returncode} "
+          f"decision={verdict.get('decision')}")
+    if proc.returncode != 0:
+        fail("the applied profile regressed vs defaults — the "
+             "proof-or-degrade contract is broken at apply time")
+
+    print("AUTOTUNE SMOKE PASSED")
+
+
+if __name__ == "__main__":
+    main()

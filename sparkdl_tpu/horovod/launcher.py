@@ -758,6 +758,17 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                         num_workers=num_workers, mode=mode,
                         job_dir=job_dir,
                         compile_cache=compile_cache or "")
+        # Autotuned perf profile pre-flight (ISSUE 12): resolve the
+        # committed per-device-kind profile and ship its knobs in
+        # every worker env, UNDER the operator (an env var already set
+        # in the driver's environment is never overridden). Applied
+        # here — inside the function the supervisor retries — so every
+        # relaunched attempt re-inherits the profile through the same
+        # env-forwarding path as the restart context; a degraded or
+        # malformed profile applies nothing and says so in the log.
+        from sparkdl_tpu.perf.profile import preflight_env
+
+        profile_env = preflight_env(os.environ)
         for r in range(num_workers):
             env = _worker_env(
                 os.environ, rank=r, size=num_workers,
@@ -766,6 +777,8 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 payload_path=payload_paths[r], job_dir=job_dir,
                 platform=platform, placement=gang_placement,
             )
+            for pk, pv in profile_env.items():
+                env.setdefault(pk, pv)
             if extra_env:
                 # Supervisor restart context (attempt number, resume
                 # step) — merged per worker, never into the driver's

@@ -109,16 +109,25 @@ def _spec_programs(target_cfg, draft_cfg, k):
 
 
 def speculative_generate(model, params, draft_params, prompt_tokens, *,
-                         max_new_tokens=32, k=4, draft_model=None,
+                         max_new_tokens=32, k=None, draft_model=None,
                          eos_id=None):
     """Greedy generation with draft-model speculation. Returns
     ``(tokens, stats)``: tokens exactly as :func:`generate` (greedy)
     would produce, ``stats`` = {"rounds", "proposed", "accepted"}.
 
+    :param k: draft length (tokens proposed per verify round). Default
+        ``None`` resolves ``SPARKDL_TPU_SPEC_DRAFT_K`` (registered in
+        :mod:`sparkdl_tpu.utils.knobs`; 4 when unset) — the env knob
+        an autotuned profile pins per device kind. An explicit ``k``
+        always wins.
     :param draft_model: model for ``draft_params`` (default: the
         target architecture — e.g. int8 weights of the same model via
         ``dataclasses.replace(cfg, quant="int8")``).
     """
+    if k is None:
+        from sparkdl_tpu.utils.knobs import read_int
+
+        k = read_int("SPARKDL_TPU_SPEC_DRAFT_K", 4)
     prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
     b, p_len = prompt_tokens.shape
     cfg = model.cfg

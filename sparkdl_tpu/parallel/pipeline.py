@@ -41,7 +41,7 @@ from jax.sharding import PartitionSpec as P
 
 
 def pipeline_apply(stage_fn, stacked_params, microbatches, *,
-                   axis_name="stage", overlap=True):
+                   axis_name="stage", overlap=None):
     """Run inside ``shard_map``: stream microbatches through stages.
 
     :param stage_fn: ``f(params_i, x) -> y`` applied by each stage
@@ -50,11 +50,15 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, *,
         (the shard of a (P, ...) stacked tree).
     :param microbatches: (M, mb, ...) — replicated across stages; only
         stage 0 reads them.
-    :param overlap: software-pipelined hop schedule (default) vs the
+    :param overlap: software-pipelined hop schedule (default; ``None``
+        resolves the ``SPARKDL_TPU_OVERLAP`` env knob) vs the
         serialized legacy lowering (see module docstring).
     :return: (M, mb, ...) outputs, replicated (psum-collected from the
         last stage).
     """
+    from sparkdl_tpu.parallel.ring_attention import resolve_overlap
+
+    overlap = resolve_overlap(overlap)
     n_stages = axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]

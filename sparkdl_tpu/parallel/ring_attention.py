@@ -72,21 +72,36 @@ def _merge_stats(acc_o, acc_m, acc_l, o, m, l):
     return acc_o, new_m, acc_l
 
 
+def resolve_overlap(overlap):
+    """The hop-schedule default: an explicit ``overlap`` wins; ``None``
+    resolves the ``SPARKDL_TPU_OVERLAP`` env knob (registered in
+    :mod:`sparkdl_tpu.utils.knobs`; on when unset) — the seam an
+    autotuned profile flips per device kind without touching call
+    sites. Read at trace time, like every other schedule choice."""
+    if overlap is not None:
+        return bool(overlap)
+    from sparkdl_tpu.utils.knobs import read_bool
+
+    return read_bool("SPARKDL_TPU_OVERLAP")
+
+
 def ring_self_attention(q, k, v, *, axis_name, causal=True, scale=None,
-                        overlap=True):
+                        overlap=None):
     """Exact (flash-accumulated) self-attention with K/V ring rotation.
 
     Args: q, k, v of shape (batch, seq_local, heads, head_dim) — the
     local sequence shard; must be called inside ``shard_map`` with the
     sequence dimension sharded over ``axis_name``.
 
-    ``overlap=True`` (default) issues each hop's ``ppermute`` before
+    ``overlap=True`` (default; ``None`` resolves the
+    ``SPARKDL_TPU_OVERLAP`` knob) issues each hop's ``ppermute`` before
     attending the block that already arrived (double-buffered carry:
     the resident block is consumed while its successor is on the
     wire), so the transfer hides under the block attention.
     ``overlap=False`` keeps the serialized attend → hop schedule — the
     equivalence oracle and the analysis bad-corpus generator.
     """
+    overlap = resolve_overlap(overlap)
     n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -450,22 +465,23 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_flash_attention(q, k, v, *, axis_name, causal=True, scale=None,
-                         bq=128, bk=128, interpret=False, overlap=True):
+                         bq=128, bk=128, interpret=False, overlap=None):
     """Ring attention whose per-block compute is the fused pallas flash
     kernel — O(S_local · D) memory per hop instead of the dense ring's
     O(S_local²) score matrix, with a fused two-ring backward.  Same
     contract as :func:`ring_self_attention`: (batch, seq_local, heads,
     head_dim) shards inside ``shard_map`` over ``axis_name``;
-    ``overlap`` selects the software-pipelined (default) vs serialized
-    hop schedule in BOTH rings."""
+    ``overlap`` selects the software-pipelined (default; ``None``
+    resolves ``SPARKDL_TPU_OVERLAP``) vs serialized hop schedule in
+    BOTH rings."""
     d = q.shape[-1]
     scale = scale or (d ** -0.5)
     return _ring_flash(q, k, v, axis_name, causal, scale, bq, bk,
-                       interpret, overlap)
+                       interpret, resolve_overlap(overlap))
 
 
 def make_ring_attention(mesh, *, causal=True, impl=None,
-                        interpret=False, overlap=True):
+                        interpret=False, overlap=None):
     """Bind ring attention to a mesh: returns f(q, k, v) taking GLOBAL
     (b, s, h, d) arrays sharded (data, seq, None, None).
 

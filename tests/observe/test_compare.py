@@ -111,6 +111,46 @@ def test_metric_filter_restricts_comparison(tmp_path):
     assert compare.main([a, b, "--metric", "fast"]) == 0
 
 
+def test_json_format_carries_the_machine_verdict(tmp_path, capsys):
+    """--format json is the autotuner/CI contract (ISSUE 12): the top
+    level names the decision and implied exit code next to the
+    per-metric medians/threshold/direction rows, so a machine consumer
+    never re-derives the cross-host or no-overlap rules."""
+    base = _write(tmp_path, "base.json", _bench(1000.0))
+    cand = _write(tmp_path, "cand.json", _bench(700.0))
+    assert compare.main([base, cand, "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["decision"] == "regression"
+    assert doc["exit_code"] == 1
+    assert doc["floor"] == 0.05
+    row = doc["metrics"][0]
+    assert row["status"] == "regression"
+    assert row["higher_is_better"] is True
+    assert row["threshold"] == 0.05
+    # ok direction
+    ok = _write(tmp_path, "ok.json", _bench(1010.0))
+    assert compare.main([base, ok, "--format", "json"]) == 0
+    assert json.loads(capsys.readouterr().out)["decision"] == "ok"
+    # no overlapping metrics
+    other = _write(tmp_path, "other.json", _bench(1.0, metric="m2"))
+    assert compare.main([base, other, "--format", "json"]) == 2
+    assert json.loads(
+        capsys.readouterr().out)["decision"] == "no-overlap"
+
+
+def test_json_verdict_cross_host_advisory(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _bench(1000.0, host="hostA"))
+    cand = _write(tmp_path, "cand.json", _bench(700.0, host="hostB"))
+    assert compare.main([base, cand, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["decision"] == "regression-advisory"
+    assert doc["exit_code"] == 0
+    assert compare.main(
+        [base, cand, "--format", "json", "--strict-host"]) == 1
+    assert json.loads(
+        capsys.readouterr().out)["decision"] == "regression"
+
+
 # -- record loading ---------------------------------------------------------
 
 

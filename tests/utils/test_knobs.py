@@ -1,0 +1,134 @@
+"""Knob registry (ISSUE 12 satellite): every ``SPARKDL_TPU_*`` env
+var the source tree reads must be registered once in
+``sparkdl_tpu.utils.knobs`` — the drift gate that makes the registry
+the catalog (same pattern as the analysis ``--list-rules`` docs
+test) — and every TUNABLE knob must be documented in the performance
+docs' knob catalog. Tier-1: pure source greps, no jax."""
+
+import re
+from pathlib import Path
+
+from sparkdl_tpu.utils import knobs
+
+REPO = Path(__file__).resolve().parents[2]
+
+# Source roots the drift gate scans. tests/ is excluded on purpose:
+# test helpers synthesize knob-shaped names (fake envs, negative
+# cases) that are not platform surface.
+SCAN_ROOTS = ("sparkdl_tpu", "sparkdl", "horovod", "benchmarks", "ci",
+              "examples", "bench.py", "__graft_entry__.py")
+
+_NAME_RE = re.compile(r"SPARKDL_TPU_[A-Z0-9_]*[A-Z0-9]")
+
+
+def _source_names():
+    names = set()
+    for root in SCAN_ROOTS:
+        path = REPO / root
+        files = [path] if path.is_file() else sorted(path.rglob("*.py"))
+        for f in files:
+            for m in _NAME_RE.finditer(f.read_text(errors="replace")):
+                names.add(m.group(0))
+    return names
+
+
+def test_every_env_var_in_tree_is_registered():
+    unregistered = sorted(
+        n for n in _source_names() if not knobs.is_registered(n)
+    )
+    assert not unregistered, (
+        "SPARKDL_TPU_* env vars read in the tree but missing from "
+        f"sparkdl_tpu/utils/knobs.py: {unregistered} — register each "
+        "(name, type, default, subsystem, tunable-or-not)")
+
+
+def test_no_dead_registry_entries():
+    """The reverse direction: a registered knob no source file
+    mentions is stale catalog — delete it or wire it. The registry
+    file itself is EXCLUDED from this scan (every registered name
+    appears there as a string literal, which would make the gate
+    vacuous)."""
+    registry_file = (REPO / "sparkdl_tpu" / "utils"
+                     / "knobs.py").resolve()
+    in_tree = set()
+    for root in SCAN_ROOTS:
+        path = REPO / root
+        files = [path] if path.is_file() else sorted(path.rglob("*.py"))
+        for f in files:
+            if f.resolve() == registry_file:
+                continue
+            for m in _NAME_RE.finditer(f.read_text(errors="replace")):
+                in_tree.add(m.group(0))
+    dead = sorted(
+        kb.name for kb in knobs.all_knobs()
+        if kb.name not in in_tree and kb.subsystem != "chaos"
+    )
+    assert not dead, f"registered knobs never read in the tree: {dead}"
+
+
+def test_tunable_knobs_documented_in_performance_docs():
+    docs = (REPO / "docs" / "performance.rst").read_text()
+    missing = [kb.name for kb in knobs.tunable_knobs()
+               if kb.name not in docs]
+    assert not missing, (
+        f"tunable knobs missing from docs/performance.rst: {missing}")
+
+
+def test_registry_shape():
+    assert len(knobs.all_knobs()) > 80
+    for kb in knobs.all_knobs():
+        assert kb.name.startswith("SPARKDL_TPU_")
+        assert kb.type in ("int", "float", "bool", "str", "enum",
+                           "path", "list")
+        assert kb.subsystem
+        if kb.tunable:
+            assert kb.trial_values, (
+                f"{kb.name}: tunable knobs must declare trial_values")
+        for bench in kb.benches:
+            assert bench in ("cpu-proxy", "serve", "gbdt")
+
+
+def test_prefix_family_membership():
+    assert knobs.is_registered("SPARKDL_TPU_CHAOS_SOMETHING_NEW")
+    assert not knobs.is_registered("SPARKDL_TPU_NOT_A_KNOB")
+
+
+def test_read_env_wins_over_default():
+    assert knobs.read("SPARKDL_TPU_PREFETCH_DEPTH", env={}) == "2"
+    assert knobs.read("SPARKDL_TPU_PREFETCH_DEPTH",
+                      env={"SPARKDL_TPU_PREFETCH_DEPTH": "7"}) == "7"
+    try:
+        knobs.read("SPARKDL_TPU_NOT_A_KNOB", env={})
+    except KeyError:
+        pass
+    else:
+        raise AssertionError("unregistered read must raise")
+
+
+def test_read_int_and_bool_helpers():
+    assert knobs.read_int("SPARKDL_TPU_PREFETCH_DEPTH", env={}) == 2
+    assert knobs.read_int("SPARKDL_TPU_SERVE_MAX_QUEUE", 7,
+                          env={}) == 7
+    try:
+        knobs.read_int("SPARKDL_TPU_SERVE_REPLICAS",
+                       env={"SPARKDL_TPU_SERVE_REPLICAS": "two"})
+    except ValueError as e:
+        # ValueError, NOT SystemExit: worker/serving threads swallow
+        # SystemExit silently and `except Exception` can't catch it
+        assert "SPARKDL_TPU_SERVE_REPLICAS" in str(e)
+    else:
+        raise AssertionError("non-integer knob must name the knob")
+    assert knobs.read_bool("SPARKDL_TPU_OVERLAP", env={}) is True
+    assert knobs.read_bool(
+        "SPARKDL_TPU_OVERLAP",
+        env={"SPARKDL_TPU_OVERLAP": "off"}) is False
+
+
+def test_tunable_bench_filter():
+    cpu = {kb.name for kb in knobs.tunable_knobs("cpu-proxy")}
+    assert "SPARKDL_TPU_LOSS_CHUNK" in cpu
+    assert "SPARKDL_TPU_GBDT_MAX_BINS" not in cpu
+    # measurement-mode selectors are never part of the search space
+    assert "SPARKDL_TPU_BENCH_NO_DONATE" not in cpu
+    gbdt = {kb.name for kb in knobs.tunable_knobs("gbdt")}
+    assert "SPARKDL_TPU_GBDT_MAX_BINS" in gbdt
