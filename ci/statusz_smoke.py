@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""CI mission-control smoke (ISSUE 14: observability): boot a 2-rank
+gang with the live status tier armed and FAIL the build unless the
+whole in-flight pipeline works against a REAL running gang:
+
+1. two ``GET /metrics`` scrapes taken MID-RUN differ (counters
+   advanced between flushes) and carry the ``build_info`` stamp;
+2. ``GET /statusz`` shows every rank's current step mid-run, and the
+   ``observe.top`` renderer turns that document into a frame;
+3. a slowed rank trips exactly the ``step_time_regression`` alert:
+   ``alert.*`` instant on the merged timeline, ``gang_alerts_total``
+   in metrics.prom, an entry in the run dir's ``alerts.json``;
+4. ``observe.doctor`` renders the alerts section from the artifacts
+   alone (and still reports no hang — a slow rank is not a wedged
+   one);
+5. the trend viewer renders this smoke's own ledger line
+   (``--format json`` CI contract).
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/statusz_smoke.py``
+(defaults the dir to ``./statusz-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step; the run dir,
+the captured mid-run scrapes, the top frame, the doctor report and
+the trend render are all left in the artifact dir for upload.
+"""
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+# Runnable as `python ci/statusz_smoke.py` from a checkout: the script
+# dir (ci/) is sys.path[0], the package root is one up.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEADLINE_S = 300
+
+
+def fail(msg):
+    print(f"STATUSZ SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _slowed_rank_main(n_fast, n_slow, fast_s, slow_s):
+    """Rank 1 slows down mid-run (the 'chaos-slow' victim); rank 0
+    keeps pace. Plain sleeps under instrument_step: the live tier
+    watches the step spans, not the math inside them."""
+    import time as _time
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.parallel.train import instrument_step
+
+    hvd.init()
+    victim = hvd.rank() == 1
+
+    def step(i):
+        slow = victim and i >= n_fast
+        _time.sleep(slow_s if slow else fast_s)
+        return i
+
+    stepped = instrument_step(step)
+    for i in range(n_fast + n_slow):
+        stepped(i)
+    return hvd.rank()
+
+
+class Scraper(threading.Thread):
+    """Mid-run evidence collector: polls /metrics and /statusz while
+    the gang runs on the main thread."""
+
+    def __init__(self, base):
+        super().__init__(name="statusz-smoke-scraper", daemon=True)
+        self.base = base
+        self.metrics_bodies = []
+        self.statusz_doc = None
+
+    def run(self):
+        deadline = time.monotonic() + DEADLINE_S
+        while time.monotonic() < deadline:
+            try:
+                body = _get(f"{self.base}/metrics")
+                if "train_step_total" in body and (
+                        not self.metrics_bodies
+                        or body != self.metrics_bodies[-1]):
+                    self.metrics_bodies.append(body)
+                doc = json.loads(_get(f"{self.base}/statusz"))
+                ranks = doc.get("ranks") or {}
+                if self.statusz_doc is None and all(
+                        isinstance(ranks.get(str(r), {}).get("step"),
+                                   int)
+                        for r in (0, 1)):
+                    self.statusz_doc = doc
+                if (len(self.metrics_bodies) >= 2
+                        and self.statusz_doc is not None):
+                    return
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.15)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "statusz-artifacts"),
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    port = _free_port()
+    os.environ.update({
+        "SPARKDL_TPU_TELEMETRY_FLUSH_S": "0.1",
+        "SPARKDL_TPU_HEARTBEAT_S": "0.2",
+        "SPARKDL_TPU_STATUSZ_PORT": str(port),
+        "SPARKDL_TPU_ALERTS": "1",
+        "SPARKDL_TPU_ALERT_CHECK_S": "0.1",
+        "SPARKDL_TPU_ALERT_MIN_STEPS": "3",
+        "SPARKDL_TPU_ALERT_WINDOW_S": "3",
+        "SPARKDL_TPU_ALERT_STEP_FACTOR": "2.0",
+    })
+
+    from sparkdl import HorovodRunner
+
+    scraper = Scraper(f"http://127.0.0.1:{port}")
+    scraper.start()
+    t0 = time.monotonic()
+    HorovodRunner(np=-2).run(
+        _slowed_rank_main, n_fast=12, n_slow=14,
+        fast_s=0.05, slow_s=0.35)
+    elapsed = time.monotonic() - t0
+    scraper.join(timeout=10)
+    print(f"gang finished in {elapsed:.1f}s; "
+          f"{len(scraper.metrics_bodies)} distinct mid-run scrape(s)")
+    if elapsed > DEADLINE_S:
+        fail(f"gang took {elapsed:.0f}s (deadline {DEADLINE_S}s)")
+
+    # 1. two mid-run /metrics snapshots differ (counters advanced)
+    if len(scraper.metrics_bodies) < 2:
+        fail("never captured two differing mid-run /metrics scrapes")
+    first, last = scraper.metrics_bodies[0], scraper.metrics_bodies[-1]
+    if first == last or "train_step_total" not in first:
+        fail("mid-run scrapes show no counter movement")
+    if "build_info{" not in last:
+        fail("/metrics scrape is missing the build_info stamp")
+    with open(os.path.join(out_dir, "scrape-first.prom"), "w") as f:
+        f.write(first)
+    with open(os.path.join(out_dir, "scrape-last.prom"), "w") as f:
+        f.write(last)
+
+    # 2. /statusz showed every rank's step; observe.top renders it
+    doc = scraper.statusz_doc
+    if doc is None:
+        fail("/statusz never showed both ranks' current step")
+    from sparkdl_tpu.observe.top import render
+
+    frame = render(doc)
+    print("---- observe.top frame (mid-run) ----")
+    print(frame)
+    with open(os.path.join(out_dir, "top-frame.txt"), "w") as f:
+        f.write(frame + "\n")
+    if "rank" not in frame:
+        fail("observe.top rendered an empty frame")
+
+    # 3. the slowed rank tripped exactly step_time_regression
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected one run dir under {out_dir}, found {run_dirs}")
+    run_dir = run_dirs[0]
+    alerts = json.load(open(os.path.join(run_dir, "alerts.json")))
+    fired = alerts.get("alerts") or []
+    rules = {a.get("rule") for a in fired}
+    if rules != {"step_time_regression"}:
+        fail(f"expected exactly step_time_regression, got {rules or 'none'}")
+    prom = open(os.path.join(run_dir, "metrics.prom")).read()
+    if 'gang_alerts_total{rank="driver",rule="step_time_regression"' \
+            not in prom:
+        fail("gang_alerts_total missing from metrics.prom")
+    trace = json.load(open(os.path.join(run_dir, "timeline.json")))
+    if not any(e.get("name") == "alert.step_time_regression"
+               for e in trace["traceEvents"]):
+        fail("alert.step_time_regression instant missing from the "
+             "merged timeline")
+
+    # 4. the doctor renders the alerts section, artifact-only
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run_dir],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    with open(os.path.join(out_dir, "doctor-report.txt"), "w") as f:
+        f.write(proc.stdout + proc.stderr)
+    if proc.returncode != 0:
+        fail(f"doctor exited {proc.returncode} (a slow rank is not a "
+             f"hang):\n{proc.stdout}\n{proc.stderr}")
+    if "step_time_regression" not in proc.stdout:
+        fail(f"doctor did not render the alert:\n{proc.stdout}")
+
+    # 5. the trend viewer renders this smoke's own ledger line
+    from sparkdl_tpu.observe.perf import (
+        append_history,
+        history_record,
+        sample_metric,
+    )
+
+    history_path = os.path.join(out_dir, "history.jsonl")
+    steps = [a["detail"]["median_step_s"] for a in fired]
+    record = history_record(
+        {"statusz_smoke_median_step_s": sample_metric(
+            steps or [0.0], unit="s", higher_is_better=False)},
+        device_kind="cpu", bench="statusz-smoke")
+    if append_history(record, path=history_path) is None:
+        fail(f"could not append the smoke ledger line to {history_path}")
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.trend",
+         "--history", history_path, "--format", "json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    with open(os.path.join(out_dir, "trend.json"), "w") as f:
+        f.write(proc.stdout)
+    if proc.returncode != 0:
+        fail(f"trend viewer exited {proc.returncode}: {proc.stderr}")
+    trend = json.loads(proc.stdout)
+    entry = trend["metrics"].get("statusz_smoke_median_step_s")
+    if not entry or entry["records"][-1]["git_sha"] != record["git_sha"]:
+        fail("trend viewer did not render the smoke's own ledger line")
+
+    print("STATUSZ SMOKE PASSED: mid-run scrapes advanced, /statusz "
+          "showed every rank, the slowed rank tripped exactly "
+          "step_time_regression, doctor rendered it, and the trend "
+          "viewer rendered the smoke's ledger line.")
+
+
+if __name__ == "__main__":
+    main()

@@ -250,6 +250,16 @@ def main():
             from sparkdl_tpu import observe
 
             observe.instant("worker.ready", cat="worker", rank=rank)
+            if observe.enabled():
+                # Build-info correlation (ISSUE 14 satellite): stamp
+                # build_info{git_sha,jax_version,device_kind} AFTER
+                # backend init so the device kind is real — every
+                # telemetry flush from here carries it, so the gang
+                # /metrics scrape and the run-dir metrics.prom join
+                # on sha without guessing.
+                from sparkdl_tpu.observe.metrics import ensure_build_info
+
+                ensure_build_info(observe.metrics())
 
             # 5. Deserialize and run the user main (under a per-rank
             # profiler trace when SPARKDL_TPU_PROFILE is set).

@@ -625,10 +625,24 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     # stalled ranks, then a kind="hang" failure the supervisor
     # classifies as the transient HANG cause.
     detector = None
+    statusz = None
+    alert_engine = None
     if telemetry is not None:
         from sparkdl_tpu.observe.health import HangDetector
 
         detector = HangDetector(num_workers)
+        # Live tier (ISSUE 14), both behind their own env latches on
+        # top of the telemetry opt-in: the statusz HTTP server
+        # (SPARKDL_TPU_STATUSZ_PORT — live /metrics, /statusz,
+        # /events against THIS attempt's merged state) and the
+        # streaming alert engine (SPARKDL_TPU_ALERTS — evaluated in
+        # the monitor loop below, findings written to the run dir's
+        # alerts.json). With neither env set these are None: no
+        # thread, no socket, no rule evaluation.
+        from sparkdl_tpu.observe.alerts import maybe_make_engine
+
+        alert_engine = maybe_make_engine(
+            telemetry, detector=detector, num_workers=num_workers)
 
     slot_claim = None
     if mode == "cluster":
@@ -651,6 +665,19 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     boot_logs = []
     boot_paths = {}  # payload path -> staged secret+payload boot file
     try:
+        if telemetry is not None:
+            # Start INSIDE the resource-owning try so the finally's
+            # close() covers every exit, including a failed spawn —
+            # a leaked statusz thread would hold the port against the
+            # supervisor's next attempt.
+            from sparkdl_tpu.observe.statusz import maybe_start_statusz
+
+            statusz = maybe_start_statusz(
+                telemetry, detector=detector, num_workers=num_workers,
+                alerts=alert_engine)
+            if statusz is not None:
+                logger.info("statusz live at http://%s/statusz",
+                            statusz.address)
         job_dir = tempfile.mkdtemp(prefix="sparkdl-tpu-job-")
         if telemetry is not None:
             # Flight-recorder recovery root: rank rings live in the
@@ -911,6 +938,13 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
         first_death = None
         while any(p.poll() is None for p in procs):
             codes = [p.poll() for p in procs]
+            if alert_engine is not None:
+                # Streaming SLO rules over the live telemetry window
+                # (throttled internally to its check cadence). Firings
+                # land as alert.* instants + gang_alerts_total here;
+                # the merged report is attached to the run dir in the
+                # finally below.
+                alert_engine.poll()
             if detector is not None and first_death is None:
                 report = detector.poll()
                 for r in report["new_stalled"]:
@@ -997,6 +1031,15 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             )
         return cloudpickle.loads(result_bytes)
     finally:
+        if statusz is not None:
+            # Stop serving BEFORE the teardown below: a scrape racing
+            # the kill path would read half-dismantled state.
+            statusz.close()
+        if alert_engine is not None and telemetry is not None:
+            # The report is attached even when nothing fired: a clean
+            # run's alerts.json proves the rules were evaluated (the
+            # false-positive guard is auditable).
+            telemetry.add_alert_report(alert_engine.report())
         if detector is not None and telemetry is not None:
             # However this attempt ended, its detector state (per-rank
             # last beat/step/collective, any verdicts) goes into the

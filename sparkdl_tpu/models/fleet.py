@@ -144,6 +144,16 @@ class EngineWorker:
     # -- handler-thread surface ---------------------------------------
 
     @property
+    def queued(self):
+        """Arrivals not yet handed to the engine."""
+        return self._arrivals.qsize()
+
+    @property
+    def inflight(self):
+        """Requests the engine has admitted and not yet finished."""
+        return len(self._live)
+
+    @property
     def depth(self):
         """Queued + in-flight work (the load-aware routing signal)."""
         return self._arrivals.qsize() + len(self._live)
@@ -505,6 +515,8 @@ class FleetFrontend:
                 "replica": w.replica,
                 "alive": bool(w.alive),
                 "depth": w.depth,
+                "queued": w.queued,
+                "inflight": w.inflight,
                 "restart_cause": w.restart_cause,
             } for w in self._workers]
 
@@ -546,15 +558,29 @@ class FleetFrontend:
         ).observe(time.perf_counter() - t0)
 
     def _sample_gauges(self):
+        from sparkdl_tpu.observe.metrics import ensure_build_info
+
+        ensure_build_info(self.metrics)
         states = self.replica_states()
         self.metrics.gauge("server_queue_depth").set(
             sum(s["depth"] for s in states if s["alive"]))
         self.metrics.gauge("server_replicas_alive").set(
             sum(s["alive"] for s in states))
         for s in states:
+            replica = str(s["replica"])
             self.metrics.gauge(
-                "server_replica_queue_depth",
-                replica=str(s["replica"])).set(s["depth"])
+                "server_replica_queue_depth", replica=replica
+            ).set(s["depth"])
+            # ISSUE 14 satellite: replica state used to be visible
+            # only through restart counters — expose the live split
+            # (waiting vs admitted) per replica on the existing
+            # /metrics surface.
+            self.metrics.gauge(
+                "fleet_replica_queue_depth", replica=replica
+            ).set(s["queued"])
+            self.metrics.gauge(
+                "fleet_replica_inflight", replica=replica
+            ).set(s["inflight"])
 
     # -- supervision ---------------------------------------------------
 
@@ -626,6 +652,13 @@ class FleetFrontend:
     # -- lifecycle ----------------------------------------------------
 
     def start(self):
+        # Make this fleet visible to any statusz server in-process
+        # (ISSUE 14: the /statusz per-replica table). Weak
+        # registration — the statusz module never keeps a closed
+        # fleet alive.
+        from sparkdl_tpu.observe.statusz import register_fleet
+
+        register_fleet(self)
         for w in self._workers:
             w.start()
         self._monitor_thread.start()
@@ -636,6 +669,9 @@ class FleetFrontend:
         return self
 
     def close(self):
+        from sparkdl_tpu.observe.statusz import unregister_fleet
+
+        unregister_fleet(self)
         self._shutdown.set()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -255,6 +255,14 @@ class ServingFrontend:
                     # Sample queue depth at scrape time: the gauge is
                     # a point-in-time reading by definition, and this
                     # keeps the hot submit path free of extra work.
+                    # The build_info stamp rides the same scrape-time
+                    # path (cheap after first call) so serving scrapes
+                    # join ledger lines on git sha.
+                    from sparkdl_tpu.observe.metrics import (
+                        ensure_build_info,
+                    )
+
+                    ensure_build_info(frontend.metrics)
                     frontend.metrics.gauge("server_queue_depth").set(
                         frontend._arrivals.qsize())
                     body = frontend.metrics.to_prometheus().encode()

@@ -25,11 +25,13 @@ worker) while ``write`` runs on the driver main thread after the gang
 drained — one lock covers both.
 """
 
+import collections
 import json
 import os
 import threading
 
 from sparkdl_tpu.observe.metrics import (
+    ensure_build_info,
     merge_snapshots,
     render_json,
     render_prometheus,
@@ -44,6 +46,13 @@ HEALTH_FILE = "health.json"
 PERF_FILE = "perf.json"
 COMMS_FILE = "comms_report.json"
 FIXIT_FILE = "fixit_report.json"
+ALERTS_FILE = "alerts.json"
+
+# Live event journal bound: the statusz SSE tail and the alert
+# engine's rolling windows only ever need the recent past, so the
+# journal is a ring — old events fall off, the write()-time artifacts
+# (which keep everything) are unaffected.
+JOURNAL_CAP = 8192
 
 # perf.json keeps the newest per-step attribution rows up to this cap
 # (the aggregate components cover the whole run either way) so a
@@ -68,6 +77,14 @@ class GangTelemetry:
         self._health_summaries = [] # one HangDetector summary/attempt
         self._comms_reports = []    # static comms budgets (pre-flight)
         self._fixit_reports = []    # verified fixit reports (pre-flight)
+        self._alert_reports = []    # one alert-engine report per attempt
+        # Live journal: every ingested worker event, in arrival order,
+        # with a monotonically increasing seq — the feed behind the
+        # statusz `/events` SSE tail and the alert engine's rolling
+        # step-time window. Ring-bounded; write()'s artifacts read the
+        # full per-rank event lists, not this.
+        self._journal = collections.deque(maxlen=JOURNAL_CAP)
+        self._journal_seq = 0
         # The driver's global registry outlives launches (a notebook
         # driver runs many); baseline it NOW so write() reports only
         # THIS launch's driver-side movement. Worker snapshots need no
@@ -87,9 +104,11 @@ class GangTelemetry:
             if metrics:
                 self._snaps[(rank, payload.get("pid"))] = metrics
             if events:
-                self._events.setdefault(rank, []).extend(
-                    e for e in events if isinstance(e, dict)
-                )
+                fresh = [e for e in events if isinstance(e, dict)]
+                self._events.setdefault(rank, []).extend(fresh)
+                for e in fresh:
+                    self._journal_seq += 1
+                    self._journal.append((self._journal_seq, rank, e))
             host = payload.get("host")
             if host:
                 self._hosts[rank] = str(host)
@@ -139,6 +158,74 @@ class GangTelemetry:
             self._fixit_reports.extend(
                 r for r in reports if isinstance(r, dict)
             )
+
+    def add_alert_report(self, report):
+        """One alert-engine report per supervised attempt (each
+        attempt constructs its own engine). Reports ACCUMULATE like
+        health summaries — a regression that fired on attempt 1 must
+        survive a clean attempt 2 into ``alerts.json`` — and write()
+        merges them: newest config, every attempt's firings. Written
+        even when no rule fired, so a clean run's artifact proves the
+        rules were evaluated and found nothing (the false-positive
+        guard is auditable, not just absent)."""
+        if isinstance(report, dict):
+            with self._lock:
+                self._alert_reports.append(report)
+
+    # -- live views (statusz / alert engine) ---------------------------------
+
+    def events_since(self, seq=0, limit=None):
+        """Journal entries newer than ``seq``: ``(newest_seq,
+        [(seq, rank, event), ...])`` — the statusz SSE tail's poll
+        unit. ``limit`` caps one batch so a slow client never makes
+        the handler build an 8k-event payload. Seqs increase with
+        deque order, so the scan walks from the RIGHT and stops at
+        the first already-seen entry — an idle poll (the common case,
+        2x/sec per SSE client) is O(1) under the same lock every
+        worker telemetry flush needs."""
+        out = []
+        with self._lock:
+            newest = self._journal_seq
+            for entry in reversed(self._journal):
+                if entry[0] <= seq:
+                    break
+                out.append(entry)
+        out.reverse()
+        if limit is not None:
+            out = out[:int(limit)]
+        return newest, out
+
+    def recent_events(self, window_s, now=None):
+        """``{rank: [event, ...]}`` for journal events whose wall-clock
+        ``ts`` falls inside the trailing ``window_s`` seconds — the
+        rolling window the live attribution (statusz) and the
+        step-time regression rule (alerts) are computed over."""
+        import time as _time
+
+        now = _time.time() if now is None else now
+        cutoff = (now - float(window_s)) * 1e6
+        out = {}
+        with self._lock:
+            entries = list(self._journal)
+        for _seq, rank, e in entries:
+            ts = e.get("ts")
+            if isinstance(ts, (int, float)) and ts >= cutoff:
+                out.setdefault(rank, []).append(e)
+        return out
+
+    def live_labeled(self):
+        """The labeled merged snapshots as they stand NOW — the same
+        shape ``write`` renders, driver series included (delta'd
+        against the construction baseline), but non-destructive: no
+        timeline drain, no file writes. The statusz ``GET /metrics``
+        body is ``render_prometheus(live_labeled())``."""
+        from sparkdl_tpu import observe
+
+        registry = observe.metrics()
+        ensure_build_info(registry)
+        driver_snap = snapshot_delta(
+            self._driver_base, registry.snapshot())
+        return self._merged(driver_snap)
 
     @staticmethod
     def _validate_snapshot(snap):
@@ -214,9 +301,13 @@ class GangTelemetry:
         if driver_registry is None:
             # The baseline only describes the process-global registry;
             # an explicitly passed registry is the caller's own and is
-            # reported as-is.
+            # reported as-is. The build-info stamp rides the driver
+            # series so run-dir scrape joins on git sha even when no
+            # worker snapshot carried one.
+            registry = observe.metrics()
+            ensure_build_info(registry)
             driver_snap = snapshot_delta(
-                self._driver_base, observe.metrics().snapshot()
+                self._driver_base, registry.snapshot()
             )
         else:
             driver_snap = driver_registry.snapshot()
@@ -261,6 +352,16 @@ class GangTelemetry:
             health = list(self._health_summaries)
             comms = list(self._comms_reports)
             fixit = list(self._fixit_reports)
+            alert_reports = list(self._alert_reports)
+        if alert_reports:
+            # Merge across attempts: newest report's config (rules,
+            # window — they only change with env, but the last attempt
+            # is the authoritative run state), CONCATENATED firings.
+            merged = dict(alert_reports[-1])
+            merged["alerts"] = [a for rep in alert_reports
+                                for a in rep.get("alerts", ())]
+            merged["attempts"] = len(alert_reports)
+            files.append((ALERTS_FILE, json.dumps(merged, indent=2)))
         if comms:
             files.append((COMMS_FILE, json.dumps(
                 {"reports": comms}, indent=2)))

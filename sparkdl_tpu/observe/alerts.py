@@ -1,0 +1,524 @@
+"""``observe.alerts``: a declarative streaming SLO rule engine over
+the LIVE gang telemetry stream.
+
+PR 3/5/7 built the post-hoc half of the single-pane-of-glass story
+(run-dir artifacts, hang post-mortems, attribution — all read by
+``observe.doctor`` after the fact); the only *in-flight* detector was
+the binary hang/stall verdict. Operators of a long gang need the
+mid-run regression signal: "step time doubled twenty minutes ago",
+"rank 3's beats are getting sparse", "HBM high-water is 94% of the
+budget" — before the run dies, not in the postmortem.
+
+This module is that signal. A small **declarative rule catalog**
+(:data:`RULES`) is evaluated by :class:`AlertEngine.poll` inside the
+launcher's existing monitor loop — the same cadence-throttled pass
+that polls the :class:`~sparkdl_tpu.observe.health.HangDetector` —
+over three live inputs that already exist:
+
+- the :class:`~sparkdl_tpu.observe.aggregate.GangTelemetry` event
+  journal (rolling window of execute-phase step spans → rolling
+  median step time, rolling overlap efficiency);
+- the merged live metric snapshots (``mfu``, ``server_queue_depth``);
+- the detector's per-rank liveness (beat ages, HBM gauges from the
+  PR 5 heartbeat payloads).
+
+Rule catalog (severities in parentheses; each rule latches ONCE per
+(rule, rank) per gang launch — a sustained condition is one alert,
+not a page storm):
+
+``step_time_regression`` (critical)
+    Rolling median execute step time over the window exceeds
+    ``SPARKDL_TPU_ALERT_STEP_FACTOR`` × the baseline. The baseline
+    is, in priority order: ``SPARKDL_TPU_ALERT_STEP_BASELINE_S``
+    (explicit seconds), a committed ledger record
+    (``benchmarks/results/history.jsonl`` — newest entry carrying a
+    ``step_time_s`` / ``train_step_seconds_mean`` metric), else
+    self-calibrated: the smallest rolling median this run has shown
+    (so a mid-run slowdown fires against the run's own healthy past).
+``heartbeat_gap`` (warning)
+    A progressing rank's last beat is older than
+    ``SPARKDL_TPU_ALERT_HEARTBEAT_GAP_FRAC`` × the stall window —
+    the early warning BELOW the hang threshold (a rank the detector
+    already classed stalled/silent is the hang machinery's story).
+``hbm_high_water`` (critical)
+    A rank's heartbeat HBM gauge (in_use, falling back to peak)
+    crossed ``SPARKDL_TPU_ALERT_HBM_FRAC`` of the per-chip
+    ``hbm_capacity_bytes`` budget (PR 8's table; dormant on chips
+    with no budget unless ``SPARKDL_TPU_HBM_BYTES`` pins one).
+``queue_depth_growth`` (warning)
+    Total serving queue depth — the merged ``server_queue_depth``
+    gauge plus every :class:`~sparkdl_tpu.models.fleet.FleetFrontend`
+    registered in-process with the statusz module (a fleet's own
+    registry is private and never crosses the control plane) — is
+    growing faster than ``SPARKDL_TPU_ALERT_QUEUE_GROWTH`` per
+    second over the window (dormant unless the knob is set —
+    growth-rate floors are workload-specific).
+``mfu_drop`` (warning)
+    Any rank's live ``mfu`` gauge fell below
+    ``SPARKDL_TPU_ALERT_MFU_MIN`` (dormant unless set).
+``overlap_drop`` (warning)
+    Rolling window overlap efficiency (PR 10's metric, recomputed
+    live from the journal) fell below
+    ``SPARKDL_TPU_ALERT_OVERLAP_MIN`` (dormant unless set).
+
+Every firing emits a typed ``alert.<rule>`` timeline instant
+(``cat="alert"``, landing on the driver lane of the merged trace), a
+``gang_alerts_total{rule,severity}`` counter, and a record in the
+engine's report — written to the run dir as ``alerts.json`` (via
+:meth:`GangTelemetry.add_alert_report`), which ``observe.doctor``
+renders in its "alerts" section, artifact-only. A clean run writes
+``alerts.json`` too, with an empty ``alerts`` list: the
+false-positive guard is auditable, not just absent.
+
+Zero-overhead contract (the PR 3 latch, extended): the engine is only
+constructed by :func:`maybe_make_engine` when BOTH telemetry is
+opted in and ``SPARKDL_TPU_ALERTS`` is truthy. Without the env there
+is no engine object, no rule evaluation, no per-step work, no
+thread — the monitor loop's ``engine is not None`` test is the whole
+cost.
+"""
+
+import collections
+import os
+import time
+
+ALERTS_ENV = "SPARKDL_TPU_ALERTS"
+WINDOW_S_ENV = "SPARKDL_TPU_ALERT_WINDOW_S"
+CHECK_S_ENV = "SPARKDL_TPU_ALERT_CHECK_S"
+STEP_FACTOR_ENV = "SPARKDL_TPU_ALERT_STEP_FACTOR"
+STEP_BASELINE_ENV = "SPARKDL_TPU_ALERT_STEP_BASELINE_S"
+MIN_STEPS_ENV = "SPARKDL_TPU_ALERT_MIN_STEPS"
+MFU_MIN_ENV = "SPARKDL_TPU_ALERT_MFU_MIN"
+OVERLAP_MIN_ENV = "SPARKDL_TPU_ALERT_OVERLAP_MIN"
+QUEUE_GROWTH_ENV = "SPARKDL_TPU_ALERT_QUEUE_GROWTH"
+HBM_FRAC_ENV = "SPARKDL_TPU_ALERT_HBM_FRAC"
+HEARTBEAT_GAP_FRAC_ENV = "SPARKDL_TPU_ALERT_HEARTBEAT_GAP_FRAC"
+
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_CHECK_S = 5.0
+DEFAULT_STEP_FACTOR = 2.0
+DEFAULT_MIN_STEPS = 5
+DEFAULT_HBM_FRAC = 0.9
+DEFAULT_HEARTBEAT_GAP_FRAC = 0.5
+
+ALERTS_SCHEMA = "sparkdl_tpu.observe.alerts/1"
+
+SEV_WARNING = "warning"
+SEV_CRITICAL = "critical"
+
+# Ledger metric names accepted as a committed step-time baseline
+# (seconds, lower is better) — in practice most gangs self-calibrate,
+# but a repo that ledgers its gang step time gets the committed
+# baseline for free.
+LEDGER_STEP_METRICS = ("step_time_s", "train_step_seconds_mean")
+
+# The declarative catalog: (rule name, severity, evaluator method
+# name, one-liner for docs/statusz). Evaluators run in this order and
+# return a list of (latch_key, detail_dict) firings.
+RULES = (
+    ("step_time_regression", SEV_CRITICAL, "_check_step_time",
+     "rolling median step time exceeds factor x baseline"),
+    ("heartbeat_gap", SEV_WARNING, "_check_heartbeat_gap",
+     "beat age beyond the warn fraction of the stall window"),
+    ("hbm_high_water", SEV_CRITICAL, "_check_hbm",
+     "device HBM in use approaching the per-chip capacity budget"),
+    ("queue_depth_growth", SEV_WARNING, "_check_queue_growth",
+     "server_queue_depth growing faster than the configured rate"),
+    ("mfu_drop", SEV_WARNING, "_check_mfu",
+     "live MFU gauge below the configured floor"),
+    ("overlap_drop", SEV_WARNING, "_check_overlap",
+     "rolling overlap efficiency below the configured floor"),
+)
+
+
+def alerts_enabled(env=None):
+    env = os.environ if env is None else env
+    return str(env.get(ALERTS_ENV) or "").strip().lower() not in (
+        "", "0", "false", "off")
+
+
+def _env_float(env, name, default):
+    v = env.get(name)
+    if v in (None, ""):
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name}={v!r} is not a number") from None
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    if not n:
+        return None
+    mid = n // 2
+    return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def maybe_make_engine(telemetry, detector=None, num_workers=None,
+                      env=None):
+    """The latch: an :class:`AlertEngine` only when BOTH telemetry is
+    live (``telemetry`` is a GangTelemetry) and ``SPARKDL_TPU_ALERTS``
+    is set truthy; None otherwise — no object, no evaluation."""
+    env = os.environ if env is None else env
+    if telemetry is None or not alerts_enabled(env):
+        return None
+    return AlertEngine(telemetry, detector=detector,
+                       num_workers=num_workers, env=env)
+
+
+class AlertEngine:
+    """Streaming rule evaluation over the live gang. ``poll`` is
+    called from the launcher monitor loop (throttled internally to
+    ``SPARKDL_TPU_ALERT_CHECK_S``); everything else is bookkeeping.
+    Thread-safety: poll runs on ONE thread (the monitor loop);
+    ``records``/``report`` snapshot under no lock because firings
+    only ever append from that same thread."""
+
+    def __init__(self, telemetry, detector=None, num_workers=None,
+                 env=None, clock=time.monotonic, wall=time.time):
+        env = os.environ if env is None else env
+        self._telemetry = telemetry
+        self._detector = detector
+        self.num_workers = num_workers
+        self._clock = clock
+        self._wall = wall
+        self.window_s = _env_float(env, WINDOW_S_ENV, DEFAULT_WINDOW_S)
+        self.check_s = _env_float(env, CHECK_S_ENV, DEFAULT_CHECK_S)
+        self.step_factor = _env_float(
+            env, STEP_FACTOR_ENV, DEFAULT_STEP_FACTOR)
+        self.min_steps = int(_env_float(
+            env, MIN_STEPS_ENV, DEFAULT_MIN_STEPS))
+        self.hbm_frac = _env_float(env, HBM_FRAC_ENV, DEFAULT_HBM_FRAC)
+        self.heartbeat_gap_frac = _env_float(
+            env, HEARTBEAT_GAP_FRAC_ENV, DEFAULT_HEARTBEAT_GAP_FRAC)
+        self.mfu_min = _env_float(env, MFU_MIN_ENV, None)
+        self.overlap_min = _env_float(env, OVERLAP_MIN_ENV, None)
+        self.queue_growth = _env_float(env, QUEUE_GROWTH_ENV, None)
+        # Baseline resolution order: explicit env seconds, committed
+        # ledger record, self-calibration (the min rolling median the
+        # run has shown, per rank).
+        explicit = _env_float(env, STEP_BASELINE_ENV, None)
+        self._baseline_source = "env" if explicit is not None else None
+        self._baselines = {}          # rank -> baseline seconds
+        self._explicit_baseline = explicit
+        if explicit is None:
+            ledger = self._ledger_baseline()
+            if ledger is not None:
+                self._explicit_baseline = ledger
+                self._baseline_source = "ledger"
+        self._fired = {}              # (rule, rank) -> record
+        self._records = []
+        self._queue_samples = collections.deque(maxlen=256)
+        self._next_check = 0.0
+
+    # -- baseline ------------------------------------------------------------
+
+    @staticmethod
+    def _ledger_baseline():
+        """Newest committed ledger entry carrying a recognized
+        step-time metric (seconds), or None. Best-effort: an absent
+        or malformed ledger must never break a launch."""
+        try:
+            from sparkdl_tpu.observe.perf import read_history
+
+            for entry in reversed(read_history()):
+                for name in LEDGER_STEP_METRICS:
+                    m = (entry.get("metrics") or {}).get(name)
+                    if isinstance(m, dict):
+                        m = m.get("value")
+                    if isinstance(m, (int, float)) and m > 0:
+                        return float(m)
+        except Exception:
+            pass
+        return None
+
+    def baseline_for(self, rank):
+        if self._explicit_baseline is not None:
+            return self._explicit_baseline
+        return self._baselines.get(rank)
+
+    # -- the poll ------------------------------------------------------------
+
+    def poll(self):
+        """One throttled evaluation pass; returns the records fired
+        by THIS pass (empty between check intervals)."""
+        now = self._clock()
+        if now < self._next_check:
+            return []
+        self._next_check = now + self.check_s
+        ctx = self._build_context()
+        fired = []
+        for rule, severity, method, _doc in RULES:
+            try:
+                firings = getattr(self, method)(ctx) or []
+            except Exception:
+                # A rule must never take down the monitor loop — a
+                # broken evaluator silently skips its pass (the other
+                # rules still run) rather than killing the gang watch.
+                continue
+            for key, detail in firings:
+                rec = self._fire(rule, severity, key, detail)
+                if rec is not None:
+                    fired.append(rec)
+        return fired
+
+    def _build_context(self):
+        events = self._telemetry.recent_events(self.window_s,
+                                               now=self._wall())
+        # Execute-phase step durations per rank (seconds), window-
+        # scoped — compile spans excluded exactly like observe.perf.
+        step_durs = {}
+        for rank, evs in events.items():
+            durs = [
+                float(e.get("dur", 0) or 0) / 1e6
+                for e in evs
+                if e.get("ph") == "X" and e.get("cat") == "train"
+                and (e.get("args") or {}).get("phase") == "execute"
+            ]
+            if durs:
+                step_durs[rank] = durs
+        gauges = {}
+        try:
+            for extra, snap in self._telemetry.live_labeled():
+                rank = extra.get("rank")
+                for g in snap.get("gauges", ()):
+                    gauges.setdefault(g["name"], []).append(
+                        (rank, g.get("labels") or {}, g.get("value")))
+        except Exception:
+            pass
+        live = self._detector.live_state() if self._detector else {}
+        return {"events": events, "step_durs": step_durs,
+                "gauges": gauges, "live": live}
+
+    # -- rule evaluators -----------------------------------------------------
+
+    def _check_step_time(self, ctx):
+        out = []
+        for rank, durs in sorted(ctx["step_durs"].items()):
+            if len(durs) < self.min_steps:
+                continue
+            med = _median(durs)
+            base = self.baseline_for(rank)
+            if base is None:
+                # First qualifying window calibrates; later windows
+                # only ever lower it (the run's healthy floor).
+                self._baselines[rank] = med
+                if self._baseline_source is None:
+                    self._baseline_source = "self"
+                continue
+            if self._explicit_baseline is None and med < base:
+                self._baselines[rank] = med
+                continue
+            if med > self.step_factor * base:
+                out.append((rank, {
+                    "rank": rank,
+                    "median_step_s": round(med, 6),
+                    "baseline_step_s": round(base, 6),
+                    "factor": round(med / base, 3),
+                    "threshold_factor": self.step_factor,
+                    "baseline_source": self._baseline_source,
+                    "steps_in_window": len(durs),
+                }))
+        return out
+
+    def _check_heartbeat_gap(self, ctx):
+        detector = self._detector
+        if detector is None:
+            return []
+        warn_at = self.heartbeat_gap_frac * detector.stall_s
+        out = []
+        for rank, info in sorted(ctx["live"].items()):
+            age = info.get("beat_age_s")
+            if (info.get("state") == "progressing"
+                    and isinstance(age, (int, float))
+                    and age > warn_at):
+                out.append((rank, {
+                    "rank": rank,
+                    "beat_age_s": age,
+                    "warn_at_s": round(warn_at, 3),
+                    "stall_s": detector.stall_s,
+                }))
+        return out
+
+    def _check_hbm(self, ctx):
+        from sparkdl_tpu.observe.perf import hbm_capacity_bytes
+
+        try:
+            capacity = hbm_capacity_bytes()
+        except Exception:
+            capacity = None
+        if not capacity:
+            return []
+        out = []
+        for rank, info in sorted(ctx["live"].items()):
+            hbm = info.get("hbm") or {}
+            used = hbm.get("in_use", hbm.get("peak"))
+            if (isinstance(used, (int, float))
+                    and used > self.hbm_frac * capacity):
+                out.append((rank, {
+                    "rank": rank,
+                    "hbm_bytes": used,
+                    "capacity_bytes": capacity,
+                    "fraction": round(used / capacity, 4),
+                    "threshold_fraction": self.hbm_frac,
+                }))
+        return out
+
+    def _check_queue_growth(self, ctx):
+        # Two live sources: the merged server_queue_depth gauge (a
+        # worker that exports one through gang telemetry) and any
+        # FleetFrontend registered IN-PROCESS with the statusz module
+        # — the fleet's own registry is private and never crosses the
+        # control plane, so without this the rule could not see the
+        # colocated serving tier at all.
+        depths = ctx["gauges"].get("server_queue_depth") or []
+        total = sum(v for _r, _l, v in depths
+                    if isinstance(v, (int, float)))
+        have_source = bool(depths)
+        try:
+            from sparkdl_tpu.observe.statusz import fleet_status
+
+            for fleet in fleet_status() or ():
+                d = fleet.get("queue_depth")
+                if isinstance(d, (int, float)):
+                    total += d
+                    have_source = True
+        except Exception:
+            pass
+        if not have_source:
+            return []
+        now = self._clock()
+        self._queue_samples.append((now, total))
+        if self.queue_growth is None:
+            return []
+        cutoff = now - self.window_s
+        window = [(t, v) for t, v in self._queue_samples if t >= cutoff]
+        if len(window) < 2:
+            return []
+        (t0, v0), (t1, v1) = window[0], window[-1]
+        span = t1 - t0
+        if span < self.window_s / 4:
+            return []   # not enough history to call a trend yet
+        rate = (v1 - v0) / span
+        if rate > self.queue_growth:
+            return [(None, {
+                "depth": v1,
+                "growth_per_s": round(rate, 4),
+                "threshold_per_s": self.queue_growth,
+                "window_s": round(span, 1),
+            })]
+        return []
+
+    def _check_mfu(self, ctx):
+        if self.mfu_min is None:
+            return []
+        out = []
+        for rank, labels, v in ctx["gauges"].get("mfu", ()):
+            # merged-snapshot rank labels are STRINGS ("0", "driver");
+            # normalize worker ranks to ints so the record carries the
+            # same rank shape as the event-based rules (the doctor and
+            # top render ' rank N' from it)
+            if isinstance(rank, str) and rank.isdigit():
+                rank = int(rank)
+            if isinstance(v, (int, float)) and v < self.mfu_min:
+                out.append((rank, {
+                    "rank": rank if isinstance(rank, int) else None,
+                    "mfu": round(v, 6),
+                    "threshold": self.mfu_min,
+                    "fn": labels.get("fn"),
+                }))
+        return out
+
+    def _check_overlap(self, ctx):
+        if self.overlap_min is None:
+            return []
+        from sparkdl_tpu.observe.perf import attribution_report
+
+        out = []
+        for rank, evs in sorted(ctx["events"].items()):
+            rep = attribution_report(evs)
+            eff = rep.get("overlap_efficiency")
+            if (rep.get("steps", 0) >= self.min_steps
+                    and isinstance(eff, (int, float))
+                    and eff < self.overlap_min):
+                out.append((rank, {
+                    "rank": rank,
+                    "overlap_efficiency": round(eff, 4),
+                    "threshold": self.overlap_min,
+                    "steps_in_window": rep["steps"],
+                }))
+        return out
+
+    # -- firing + report -----------------------------------------------------
+
+    def _fire(self, rule, severity, key, detail):
+        """Latch-once per (rule, key): emit the timeline instant and
+        counter, append the record. Returns the record, or None when
+        this (rule, key) already fired this launch."""
+        latch = (rule, key)
+        if latch in self._fired:
+            return None
+        from sparkdl_tpu import observe
+
+        record = {
+            "rule": rule,
+            "severity": severity,
+            "rank": key if isinstance(key, int) else None,
+            "ts": self._wall(),
+            "detail": dict(detail),
+        }
+        self._fired[latch] = record
+        self._records.append(record)
+        observe.instant(f"alert.{rule}", cat="alert",
+                        severity=severity, **detail)
+        observe.inc("gang_alerts_total", rule=rule, severity=severity)
+        return record
+
+    def records(self):
+        return list(self._records)
+
+    def report(self):
+        """The ``alerts.json`` payload: catalog + config + firings.
+        Written by :meth:`GangTelemetry.write` even when ``alerts``
+        is empty — a clean run's artifact says the rules ran."""
+        return {
+            "schema": ALERTS_SCHEMA,
+            "enabled": True,
+            "window_s": self.window_s,
+            "check_s": self.check_s,
+            "rules": [
+                {"rule": r, "severity": s, "doc": doc}
+                for r, s, _m, doc in RULES
+            ],
+            "baseline_step_s": (
+                self._explicit_baseline
+                if self._explicit_baseline is not None
+                else ({str(r): round(b, 6)
+                       for r, b in sorted(self._baselines.items())}
+                      or None)),
+            "baseline_source": self._baseline_source,
+            "alerts": self.records(),
+        }
+
+
+def format_alert_line(record):
+    """The one human rendering of a firing record —
+    ``[severity] rule rank N: k=v, ...`` — shared by
+    ``observe.doctor`` and ``observe.top`` so the two surfaces can
+    never render the same ``alerts.json`` differently."""
+    where = (f" rank {record['rank']}"
+             if record.get("rank") is not None else "")
+    detail = record.get("detail") or {}
+    extras = ", ".join(f"{k}={v}" for k, v in sorted(detail.items())
+                       if k != "rank")
+    return (f"[{record.get('severity')}] {record.get('rule')}{where}"
+            + (f": {extras}" if extras else ""))
+
+
+__all__ = [
+    "AlertEngine", "maybe_make_engine", "alerts_enabled",
+    "format_alert_line",
+    "RULES", "ALERTS_SCHEMA", "SEV_WARNING", "SEV_CRITICAL",
+]

@@ -235,6 +235,26 @@ def _diagnose_fixit(run_dir):
     return {"reports": out}
 
 
+def _diagnose_alerts(run_dir):
+    """Live-alert section (or None when the run predates the alert
+    engine / never enabled it): the ``alerts.json`` the launcher
+    wrote — rule catalog, baseline, and every firing, exactly as the
+    engine saw them mid-run. Artifact-only like everything else here:
+    no jax, no live gang, reproduced from the file alone."""
+    doc = _load_json(os.path.join(run_dir, "alerts.json"))
+    if not isinstance(doc, dict):
+        return None
+    fired = [a for a in doc.get("alerts", ()) if isinstance(a, dict)]
+    return {
+        "enabled": bool(doc.get("enabled")),
+        "rules": [r.get("rule") for r in doc.get("rules", ())
+                  if isinstance(r, dict)],
+        "baseline_step_s": doc.get("baseline_step_s"),
+        "baseline_source": doc.get("baseline_source"),
+        "fired": fired,
+    }
+
+
 def _diagnose_serving(events, by_rank, top_n=5):
     """Serving-run section (or None for pure gang dirs): slowest
     requests by TTFT, the admission rejection/deferral breakdown, and
@@ -421,6 +441,7 @@ def diagnose(run_dir):
         "recovered_from_flight_recorder": bool(ring_fresh),
         "flight_recorder_recovered_events": len(ring_fresh),
         "serving": _diagnose_serving(events, by_rank),
+        "alerts": _diagnose_alerts(run_dir),
         "perf": _diagnose_perf(run_dir, events, by_rank),
         "comms": _diagnose_comms(run_dir, by_rank),
         "fixit": fixit,
@@ -490,6 +511,21 @@ def render_text(diag):
             f"NOTE: {diag.get('flight_recorder_recovered_events')} "
             "event(s) recovered from the flight-recorder ring "
             "(the process died before its final artifact write)")
+    alerts = diag.get("alerts")
+    if alerts:
+        fired = alerts.get("fired") or []
+        if not alerts.get("enabled"):
+            pass
+        elif not fired:
+            lines.append(
+                f"alerts: none fired ({len(alerts.get('rules') or [])}"
+                " rule(s) evaluated)")
+        else:
+            from sparkdl_tpu.observe.alerts import format_alert_line
+
+            lines.append(f"alerts: {len(fired)} fired")
+            for a in fired:
+                lines.append("  " + format_alert_line(a))
     perf = diag.get("perf")
     if perf:
         lines.append("where the time went (per step-thread second):")

@@ -410,6 +410,41 @@ class HangDetector:
                 )
             return "\n".join(lines)
 
+    def live_state(self):
+        """Per-rank liveness as it stands NOW — the ``/statusz``
+        ``ranks`` table and the alert engine's heartbeat-gap input.
+        One dict per EXPECTED rank (a rank that never beat shows up as
+        ``state="unseen"``, beat_age None), with the detector's own
+        stall/silent classification and the age of the last beat on
+        this detector's clock."""
+        now = self._clock()
+        out = {}
+        with self._lock:
+            for rank in sorted(set(range(self.num_workers))
+                               | set(self._ranks)):
+                info = self._ranks.get(rank)
+                if info is None:
+                    out[rank] = {
+                        "state": ("silent" if rank in self._silent
+                                  else "unseen"),
+                        "step": None, "progress": None,
+                        "collective": None, "hbm": {},
+                        "beat_age_s": None,
+                    }
+                    continue
+                state = ("stalled" if rank in self._stalled
+                         else "silent" if rank in self._silent
+                         else "progressing")
+                out[rank] = {
+                    "state": state,
+                    "step": info.get("step"),
+                    "progress": info.get("progress"),
+                    "collective": info.get("collective"),
+                    "hbm": dict(info.get("hbm") or {}),
+                    "beat_age_s": round(now - info["last_beat"], 3),
+                }
+        return out
+
     def summary(self):
         """JSON-able detector state for ``health.json`` in the merged
         run dir (what ``observe.doctor`` diagnoses from)."""

@@ -29,6 +29,7 @@ per series.
 
 import bisect
 import json
+import sys
 import threading
 import time
 
@@ -213,6 +214,66 @@ class Registry:
 
     def to_json(self, indent=None):
         return render_json([({}, self.snapshot())], indent=indent)
+
+
+# -- build-info correlation ---------------------------------------------------
+#
+# Scrapes and ledger lines must join on the same git sha without
+# guessing, so every *export surface* (serving /metrics, the gang
+# statusz /metrics, the run-dir metrics.prom) stamps a constant
+# ``build_info{git_sha,jax_version,device_kind} 1`` gauge onto its
+# registry before rendering. Injection is explicit per surface — not
+# inside ``snapshot()`` — so raw Registries stay exactly what their
+# callers put in them (golden exports, unit tests), while every wire
+# endpoint carries the correlation labels.
+
+_build_info_labels = None
+_build_info_lock = threading.Lock()
+
+
+def build_info_labels():
+    """Process-lifetime constant labels: short git sha of this
+    checkout (``none`` outside one), the jax version WITHOUT importing
+    jax (``sys.modules`` when already imported, package metadata
+    otherwise — a metrics export must never be the thing that
+    initializes a backend), and the probed device kind."""
+    global _build_info_labels
+    with _build_info_lock:
+        if _build_info_labels is None:
+            from sparkdl_tpu.observe import perf
+
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                jax_version = getattr(jax, "__version__", "unknown")
+            else:
+                try:
+                    from importlib import metadata
+
+                    jax_version = metadata.version("jax")
+                except Exception:
+                    jax_version = "uninstalled"
+            _build_info_labels = {
+                "git_sha": perf.git_sha() or "none",
+                "jax_version": jax_version,
+                "device_kind": perf.device_kind() or "none",
+            }
+        return dict(_build_info_labels)
+
+
+def ensure_build_info(registry):
+    """Stamp the ``build_info`` gauge (value 1, labels from
+    :func:`build_info_labels`) onto ``registry``. Idempotent and
+    cheap after the first call (labels are cached); returns the
+    labels so callers can reuse them in their own records."""
+    labels = build_info_labels()
+    registry.gauge("build_info", **labels).set(1)
+    return labels
+
+
+def _reset_build_info_for_tests():
+    global _build_info_labels
+    with _build_info_lock:
+        _build_info_labels = None
 
 
 # -- snapshot merging and rendering (driver-side gang view) -----------------

@@ -1,0 +1,384 @@
+"""``observe.statusz``: the live tier of gang observability — a
+driver-side HTTP status server for a RUNNING gang.
+
+The reference's one observable surface is ``log_to_driver``
+(``runner_base.py`` docstrings); PR 3/5/7 made the *post-hoc* story
+excellent (run-dir artifacts, post-mortems, attribution), but a live
+gang was a black box between launch and ``GangTelemetry.write``. This
+server closes the gap by exposing, over plain HTTP on the driver, the
+telemetry that ALREADY arrives every flush interval (the per-rank
+``MSG_TELEMETRY`` cumulative snapshots and ``MSG_HEARTBEAT`` payloads
+today just wait for ``write()``):
+
+``GET /metrics``
+    Live gang-merged Prometheus text: :func:`render_prometheus` over
+    :meth:`GangTelemetry.live_labeled` — the newest cumulative
+    snapshot per rank incarnation, merged exactly as the run-dir
+    ``metrics.prom`` will be, plus the driver's own delta and the
+    ``build_info{git_sha,jax_version,device_kind}`` stamp. Point a
+    Prometheus scraper here and the run-dir artifact becomes the
+    scrape's final sample, not the only one.
+``GET /statusz``
+    One JSON document for humans and ``observe.top``: per-rank
+    step / progress / last-collective / HBM / beat-age from the PR 5
+    heartbeat state, supervisor attempt counters, a rolling PR 7
+    attribution window (component fractions, median step time,
+    overlap efficiency, MFU) per rank, the alert engine's rule
+    catalog + firings, and — when a
+    :class:`~sparkdl_tpu.models.fleet.FleetFrontend` has registered
+    itself via :func:`register_fleet` — a per-replica
+    depth/in-flight/restarts table.
+``GET /events``
+    Server-sent-events tail of the live merged timeline: each journal
+    event as one ``data:`` line with its sequence as the SSE ``id``,
+    so ``curl -N .../events`` watches the gang's step spans, health
+    verdicts and chaos instants stream by in real time.
+
+Zero-overhead contract (the PR 3 latch, extended): everything here is
+inert unless ``SPARKDL_TPU_STATUSZ_PORT`` is set — no thread, no
+socket, no object (:func:`maybe_start_statusz` returns None). With
+the env set the server runs on daemon threads named
+``sparkdl-tpu-statusz*`` and costs the gang nothing between requests;
+handlers only READ (journal snapshots, merged metric renders) — they
+never mutate gang state, so a scrape cannot perturb the run.
+"""
+
+import json
+import os
+import threading
+import time
+import weakref
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+STATUSZ_PORT_ENV = "SPARKDL_TPU_STATUSZ_PORT"
+
+STATUSZ_SCHEMA = "sparkdl_tpu.observe.statusz/1"
+
+# Rolling window the /statusz perf section is computed over (shares
+# the alert engine's window knob so the two live views agree), and
+# the rule catalog the /statusz alerts section names.
+from sparkdl_tpu.observe.alerts import (  # noqa: E402  (constant import)
+    DEFAULT_WINDOW_S,
+    RULES as ALERT_RULES,
+    WINDOW_S_ENV,
+    _env_float,
+)
+
+# -- fleet registration -------------------------------------------------------
+#
+# A FleetFrontend lives in the serving process, not inside the gang
+# machinery; when one starts it registers itself here (weakly — the
+# status server must never keep a closed fleet alive) so any statusz
+# server in the same process can render its per-replica table.
+
+_fleets = []
+_fleets_lock = threading.Lock()
+
+
+def register_fleet(frontend):
+    """Called by :meth:`FleetFrontend.start`; idempotent (a restarted
+    frontend never duplicates its row), and a dead ref is pruned on
+    the next read."""
+    with _fleets_lock:
+        if not any(ref() is frontend for ref in _fleets):
+            _fleets.append(weakref.ref(frontend))
+
+
+def unregister_fleet(frontend):
+    """Called by :meth:`FleetFrontend.close`: a CLOSED fleet must
+    leave the table immediately — the weakref only dies when the
+    object is collected, and callers routinely keep the variable
+    around after close(), which would render a dead fleet's replica
+    rows indistinguishable from a crashed live one."""
+    with _fleets_lock:
+        _fleets[:] = [ref for ref in _fleets
+                      if ref() is not None and ref() is not frontend]
+
+
+def fleet_status():
+    """Per-replica state of every live registered fleet, or None when
+    none registered (the /statusz key is absent rather than empty —
+    gang-only runs have no fleet section at all)."""
+    out = []
+    with _fleets_lock:
+        live = []
+        for ref in _fleets:
+            fleet = ref()
+            if fleet is None:
+                continue
+            live.append(ref)
+            try:
+                out.append({
+                    "address": list(fleet.address),
+                    "replicas": fleet.replica_states(),
+                    "restarts": fleet._restarts,
+                    "max_queue": fleet.max_queue,
+                    "queue_depth": fleet.queue_depth(),
+                })
+            except Exception:
+                continue
+        _fleets[:] = live
+    return out or None
+
+
+def _reset_fleets_for_tests():
+    with _fleets_lock:
+        _fleets.clear()
+
+
+# -- the server ---------------------------------------------------------------
+
+
+def statusz_port(env=None):
+    """The configured port, or None when the latch is closed. ``0``
+    is a valid (ephemeral) port — the bound port is on the returned
+    server's ``port`` attribute."""
+    env = os.environ if env is None else env
+    raw = env.get(STATUSZ_PORT_ENV)
+    if raw in (None, ""):
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{STATUSZ_PORT_ENV}={raw!r} is not a port number") from None
+
+
+def maybe_start_statusz(telemetry, detector=None, num_workers=None,
+                        alerts=None, env=None):
+    """The latch: a running :class:`StatuszServer` when
+    ``SPARKDL_TPU_STATUSZ_PORT`` is set and telemetry is live, None
+    otherwise — no thread, no socket, no allocation on the default
+    path. A bind failure (port already taken by another gang) logs
+    and returns None rather than failing the launch: the gang matters
+    more than its dashboard."""
+    port = statusz_port(env)
+    if port is None or telemetry is None:
+        return None
+    try:
+        return StatuszServer(
+            telemetry, detector=detector, num_workers=num_workers,
+            alerts=alerts, port=port, env=env,
+        ).start()
+    except OSError as e:
+        import logging
+
+        logging.getLogger("HorovodRunner").warning(
+            "statusz server failed to bind port %s: %s — continuing "
+            "without the live endpoint", port, e)
+        return None
+
+
+class StatuszServer:
+    """The driver-side HTTP server. Construction binds the socket;
+    :meth:`start` begins serving on a daemon thread; :meth:`close` is
+    idempotent and joins the serve thread."""
+
+    def __init__(self, telemetry, detector=None, num_workers=None,
+                 alerts=None, host="127.0.0.1", port=0, env=None):
+        env = os.environ if env is None else env
+        self._telemetry = telemetry
+        self._detector = detector
+        self._alerts = alerts
+        self.num_workers = num_workers
+        self._t0 = time.time()
+        self._closed = threading.Event()
+        # same knob as the alert engine, same env mapping, same
+        # knob-naming parse error, so the two live views always
+        # describe the same window
+        self.window_s = _env_float(env, WINDOW_S_ENV,
+                                   DEFAULT_WINDOW_S)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):    # scrapes stay out of stderr
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    server._serve_metrics(self)
+                elif path == "/statusz":
+                    server._serve_statusz(self)
+                elif path == "/events":
+                    server._serve_events(self)
+                elif path == "/healthz":
+                    server._send(self, 200, b"ok\n", "text/plain")
+                else:
+                    self.send_error(404)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.address = f"{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="sparkdl-tpu-statusz", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        from sparkdl_tpu import observe
+
+        observe.instant("statusz.start", cat="statusz",
+                        address=self.address)
+        return self
+
+    def close(self):
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+
+    # -- handlers ------------------------------------------------------------
+
+    @staticmethod
+    def _send(handler, code, body, content_type):
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _serve_metrics(self, handler):
+        from sparkdl_tpu.observe.metrics import render_prometheus
+
+        body = render_prometheus(self._telemetry.live_labeled()).encode()
+        self._send(handler, 200, body,
+                   "text/plain; version=0.0.4; charset=utf-8")
+
+    def status_doc(self):
+        """The /statusz JSON document (also what ``observe.top``
+        renders). Pure reads — safe at any moment of the run."""
+        doc = {
+            "schema": STATUSZ_SCHEMA,
+            "ts": time.time(),
+            "uptime_s": round(time.time() - self._t0, 1),
+            "gang": {"num_workers": self.num_workers},
+            "ranks": {},
+            "supervisor": self._supervisor_state(),
+            "perf": self._perf_window(),
+        }
+        if self._detector is not None:
+            doc["ranks"] = {
+                str(r): info
+                for r, info in self._detector.live_state().items()
+            }
+            doc["gang"]["stall_s"] = self._detector.stall_s
+            doc["gang"]["hang_verdict"] = self._detector.hang_verdict
+        if self._alerts is not None:
+            doc["alerts"] = {
+                "enabled": True,
+                "fired": self._alerts.records(),
+                "rules": [r for r, _s, _m, _d in ALERT_RULES],
+            }
+        else:
+            doc["alerts"] = {"enabled": False, "fired": []}
+        fleet = fleet_status()
+        if fleet is not None:
+            doc["fleet"] = fleet
+        return doc
+
+    def _serve_statusz(self, handler):
+        body = (json.dumps(self.status_doc(), indent=2, sort_keys=True)
+                + "\n").encode()
+        self._send(handler, 200, body, "application/json")
+
+    def _supervisor_state(self):
+        """Driver-side supervision counters as they stand: attempts,
+        restarts, classified failures (the supervisor already counts
+        them on the driver registry; reading a counter that was never
+        written returns 0)."""
+        from sparkdl_tpu import observe
+
+        reg = observe.metrics()
+        return {
+            "attempts_total": reg.counter("gang_attempts_total").value,
+            "restarts_total": reg.counter("gang_restarts_total").value,
+        }
+
+    def _perf_window(self):
+        """Rolling attribution over the journal window, per rank:
+        median step time, component fractions, overlap efficiency —
+        plus the live MFU gauges from the merged snapshots."""
+        from sparkdl_tpu.observe.alerts import _median
+        from sparkdl_tpu.observe.perf import attribution_report
+
+        events = self._telemetry.recent_events(self.window_s)
+        per_rank = {}
+        for rank, evs in sorted(events.items()):
+            rep = attribution_report(evs)
+            if not rep.get("steps"):
+                continue
+            median = _median(
+                [r["dur_s"] for r in rep.get("per_step", ())])
+            per_rank[str(rank)] = {
+                "steps": rep["steps"],
+                "median_step_s": round(median, 6),
+                "fractions": rep.get("fractions"),
+                "overlap_efficiency": rep.get("overlap_efficiency"),
+            }
+        # live MFU: newest mfu gauge per rank from the merged view
+        try:
+            for extra, snap in self._telemetry.live_labeled():
+                rank = extra.get("rank")
+                if rank in per_rank:
+                    for g in snap.get("gauges", ()):
+                        if g["name"] == "mfu":
+                            per_rank[rank]["mfu"] = g["value"]
+                            break
+        except Exception:
+            pass
+        return {"window_s": self.window_s, "per_rank": per_rank}
+
+    def _serve_events(self, handler):
+        """SSE tail of the live journal. Streams until the client
+        disconnects or the server closes; polls the journal at the
+        telemetry flush cadence (new events only arrive on flushes)."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.end_headers()
+        seq = 0
+        try:
+            # Resume support: Last-Event-ID picks up where a dropped
+            # client left off (the journal ring bounds how far back).
+            last = handler.headers.get("Last-Event-ID")
+            if last:
+                seq = int(last)
+        except (TypeError, ValueError):
+            seq = 0
+        try:
+            while not self._closed.is_set():
+                newest, batch = self._telemetry.events_since(
+                    seq, limit=256)
+                # advance past what was SENT, not past the journal's
+                # newest — a limit-truncated batch must not skip the
+                # remainder on the next poll
+                seq = batch[-1][0] if batch else newest
+                for ev_seq, rank, event in batch:
+                    payload = json.dumps(
+                        {"rank": rank, "event": event},
+                        sort_keys=True)
+                    handler.wfile.write(
+                        f"id: {ev_seq}\ndata: {payload}\n\n".encode())
+                if not batch:
+                    # comment line = keepalive; also how a dead client
+                    # is detected between event batches
+                    handler.wfile.write(b": keepalive\n\n")
+                handler.wfile.flush()
+                self._closed.wait(0.5)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+
+
+__all__ = [
+    "StatuszServer", "maybe_start_statusz", "statusz_port",
+    "register_fleet", "fleet_status", "STATUSZ_PORT_ENV",
+    "STATUSZ_SCHEMA",
+]

@@ -291,6 +291,37 @@ def _build():
         k("SPARKDL_TPU_NATIVE_LOGS", "bool", None, "observe",
           "native control-plane log transport toggle"),
 
+        # -- live status & alerts (ISSUE 14) ------------------------
+        k("SPARKDL_TPU_STATUSZ_PORT", "int", None, "observe",
+          "driver-side live status HTTP port (GET /metrics, "
+          "/statusz, /events); unset = no thread, no socket"),
+        k("SPARKDL_TPU_ALERTS", "bool", "0", "observe",
+          "enable the streaming SLO alert engine in the launcher "
+          "monitor loop (alerts.json + alert.* instants)"),
+        k("SPARKDL_TPU_ALERT_WINDOW_S", "float", "60", "observe",
+          "rolling window for live attribution and alert rules (s)"),
+        k("SPARKDL_TPU_ALERT_CHECK_S", "float", "5", "observe",
+          "alert rule evaluation cadence (s)"),
+        k("SPARKDL_TPU_ALERT_STEP_FACTOR", "float", "2.0", "observe",
+          "step-time regression fires at median > factor x baseline"),
+        k("SPARKDL_TPU_ALERT_STEP_BASELINE_S", "float", None,
+          "observe", "explicit step-time baseline (s); default: "
+          "committed ledger record, else self-calibrated"),
+        k("SPARKDL_TPU_ALERT_MIN_STEPS", "int", "5", "observe",
+          "minimum windowed steps before step/overlap rules judge"),
+        k("SPARKDL_TPU_ALERT_MFU_MIN", "float", None, "observe",
+          "mfu_drop alert floor (dormant unless set)"),
+        k("SPARKDL_TPU_ALERT_OVERLAP_MIN", "float", None, "observe",
+          "overlap_drop alert floor (dormant unless set)"),
+        k("SPARKDL_TPU_ALERT_QUEUE_GROWTH", "float", None, "observe",
+          "queue_depth_growth alert rate floor per second (dormant "
+          "unless set)"),
+        k("SPARKDL_TPU_ALERT_HBM_FRAC", "float", "0.9", "observe",
+          "hbm_high_water alert fraction of hbm_capacity_bytes"),
+        k("SPARKDL_TPU_ALERT_HEARTBEAT_GAP_FRAC", "float", "0.5",
+          "observe", "heartbeat_gap warns at this fraction of the "
+          "stall window"),
+
         # -- compile cache ------------------------------------------
         k("SPARKDL_TPU_COMPILE_CACHE_DIR", "path", None, "compile",
           "persistent XLA + AOT step cache root (warm starts)"),

@@ -1,0 +1,408 @@
+"""Streaming SLO alert engine (ISSUE 14 tentpole): the latch, each
+rule over synthetic live state, the once-per-launch firing latch, and
+the acceptance gang: an injected slowdown fires exactly the
+step-time-regression rule — timeline instant, counter, alerts.json,
+doctor — while the clean-run guard lives in test_statusz."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe.aggregate import GangTelemetry
+from sparkdl_tpu.observe.alerts import (
+    AlertEngine,
+    RULES,
+    maybe_make_engine,
+)
+from sparkdl_tpu.observe.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _payload(pid, events=(), gauges=()):
+    reg = Registry()
+    for name, value, labels in gauges:
+        reg.gauge(name, **labels).set(value)
+    return {"pid": pid, "host": "hostA", "metrics": reg.snapshot(),
+            "events": list(events)}
+
+
+def _steps(t0, durs, phase="execute"):
+    out = []
+    t = t0
+    for i, d in enumerate(durs):
+        out.append({"name": "train_step", "cat": "train", "ph": "X",
+                    "ts": int(t * 1e6), "dur": int(d * 1e6), "tid": 1,
+                    "args": {"step": i, "phase": phase}})
+        t += d
+    return out
+
+
+ENV = {
+    "SPARKDL_TPU_ALERTS": "1",
+    "SPARKDL_TPU_ALERT_CHECK_S": "0",
+    "SPARKDL_TPU_ALERT_MIN_STEPS": "3",
+    "SPARKDL_TPU_ALERT_STEP_FACTOR": "2.0",
+    "SPARKDL_TPU_ALERT_WINDOW_S": "60",
+}
+
+
+# -- the latch ----------------------------------------------------------------
+
+
+def test_latch_no_env_no_engine():
+    assert maybe_make_engine(GangTelemetry(), env={}) is None
+    assert maybe_make_engine(
+        GangTelemetry(), env={"SPARKDL_TPU_ALERTS": "0"}) is None
+    assert maybe_make_engine(None, env=ENV) is None
+
+
+def test_latch_env_makes_engine():
+    engine = maybe_make_engine(GangTelemetry(), env=ENV)
+    assert isinstance(engine, AlertEngine)
+
+
+# -- step-time regression -----------------------------------------------------
+
+
+def test_step_regression_self_calibrates_then_fires_once():
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, env=ENV)
+    now = time.time()
+    # healthy window: calibrates the baseline (no fire)
+    gt.ingest(0, _payload(100, events=_steps(now - 10,
+                                             [0.01, 0.011, 0.009])))
+    assert engine.poll() == []
+    assert engine.baseline_for(0) == pytest.approx(0.01, rel=0.2)
+    # the regression: slow steps dominate the window's median
+    gt.ingest(0, _payload(
+        100, events=_steps(now - 5, [0.05] * 6)))
+    (rec,) = engine.poll()
+    assert rec["rule"] == "step_time_regression"
+    assert rec["severity"] == "critical"
+    assert rec["rank"] == 0
+    assert rec["detail"]["median_step_s"] >= 0.04
+    assert rec["detail"]["baseline_source"] == "self"
+    # latched: the sustained condition is ONE alert, not a storm
+    assert engine.poll() == []
+    assert len(engine.records()) == 1
+
+
+def test_step_regression_explicit_baseline_env():
+    env = dict(ENV, SPARKDL_TPU_ALERT_STEP_BASELINE_S="0.02")
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, env=env)
+    gt.ingest(1, _payload(100, events=_steps(time.time() - 5,
+                                             [0.05] * 5)))
+    (rec,) = engine.poll()
+    assert rec["rank"] == 1
+    assert rec["detail"]["baseline_source"] == "env"
+    assert rec["detail"]["baseline_step_s"] == pytest.approx(0.02)
+
+
+def test_clean_run_fires_nothing():
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, env=ENV)
+    now = time.time()
+    for burst in range(4):
+        gt.ingest(0, _payload(100, events=_steps(
+            now - 20 + burst * 2, [0.01, 0.011, 0.0095, 0.0105])))
+        assert engine.poll() == []
+    assert engine.records() == []
+    report = engine.report()
+    assert report["enabled"] is True
+    assert report["alerts"] == []
+    assert [r["rule"] for r in report["rules"]] == [
+        r for r, _s, _m, _d in RULES]
+
+
+def test_compile_phase_never_counts():
+    """The first call's compile span must not poison the baseline
+    (a 30s compile is not a 30s step)."""
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, env=ENV)
+    now = time.time()
+    gt.ingest(0, _payload(100, events=(
+        _steps(now - 30, [30.0], phase="compile")
+        + _steps(now - 10, [0.01] * 4))))
+    assert engine.poll() == []
+    assert engine.baseline_for(0) == pytest.approx(0.01, rel=0.2)
+
+
+# -- the other rules ----------------------------------------------------------
+
+
+class _FakeDetector:
+    def __init__(self, stall_s, live):
+        self.stall_s = stall_s
+        self._live = live
+
+    def live_state(self):
+        return self._live
+
+
+def test_heartbeat_gap_warns_below_hang_threshold():
+    det = _FakeDetector(stall_s=100, live={
+        0: {"state": "progressing", "beat_age_s": 60.0, "hbm": {}},
+        1: {"state": "progressing", "beat_age_s": 1.0, "hbm": {}},
+        # already the hang machinery's story: no duplicate alert
+        2: {"state": "stalled", "beat_age_s": 70.0, "hbm": {}},
+    })
+    engine = AlertEngine(GangTelemetry(), detector=det, env=ENV)
+    recs = engine.poll()
+    assert [r["rank"] for r in recs] == [0]
+    assert recs[0]["rule"] == "heartbeat_gap"
+    assert recs[0]["severity"] == "warning"
+    assert recs[0]["detail"]["warn_at_s"] == pytest.approx(50.0)
+
+
+def test_hbm_high_water_against_pinned_capacity(monkeypatch):
+    monkeypatch.setenv("SPARKDL_TPU_HBM_BYTES", "1000")
+    det = _FakeDetector(stall_s=100, live={
+        0: {"state": "progressing", "beat_age_s": 1.0,
+            "hbm": {"in_use": 950}},
+        1: {"state": "progressing", "beat_age_s": 1.0,
+            "hbm": {"in_use": 100}},
+    })
+    engine = AlertEngine(GangTelemetry(), detector=det, env=ENV)
+    (rec,) = engine.poll()
+    assert rec["rule"] == "hbm_high_water"
+    assert rec["rank"] == 0
+    assert rec["detail"]["fraction"] == pytest.approx(0.95)
+
+
+def test_hbm_rule_dormant_without_capacity(monkeypatch):
+    # cpu: no chip budget, no env pin -> the rule never judges
+    monkeypatch.delenv("SPARKDL_TPU_HBM_BYTES", raising=False)
+    det = _FakeDetector(stall_s=100, live={
+        0: {"state": "progressing", "beat_age_s": 1.0,
+            "hbm": {"in_use": 10**15}},
+    })
+    engine = AlertEngine(GangTelemetry(), detector=det, env=ENV)
+    assert engine.poll() == []
+
+
+def test_queue_growth_sees_in_process_fleet():
+    """The real deployment shape: a colocated FleetFrontend's queue
+    depth is private to its own registry and never crosses the
+    control plane — the rule must read it through the statusz fleet
+    registration instead."""
+    import importlib
+
+    statusz_mod = importlib.import_module(
+        "sparkdl_tpu.observe.statusz")
+    statusz_mod._reset_fleets_for_tests()
+
+    class FakeFleet:
+        depth = 0
+
+        def replica_states(self):
+            return []
+
+        def queue_depth(self):
+            return self.depth
+
+        address = ("127.0.0.1", 1)
+        max_queue = None
+        _restarts = 0
+
+    fleet = FakeFleet()
+    statusz_mod.register_fleet(fleet)
+    try:
+        env = dict(ENV, SPARKDL_TPU_ALERT_QUEUE_GROWTH="1.0",
+                   SPARKDL_TPU_ALERT_WINDOW_S="60")
+        clock = {"t": 0.0}
+        engine = AlertEngine(GangTelemetry(), env=env,
+                             clock=lambda: clock["t"])
+        fired = []
+        for _tick in range(6):
+            fired += engine.poll()
+            clock["t"] += 10.0
+            fleet.depth += 100      # 10/s >> the 1/s floor
+        assert fired and fired[0]["rule"] == "queue_depth_growth"
+    finally:
+        statusz_mod._reset_fleets_for_tests()
+
+
+def test_queue_growth_fires_on_trend():
+    env = dict(ENV, SPARKDL_TPU_ALERT_QUEUE_GROWTH="1.0",
+               SPARKDL_TPU_ALERT_WINDOW_S="60")
+    clock = {"t": 0.0}
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, env=env, clock=lambda: clock["t"])
+    depth = 0
+    fired = []
+    for tick in range(8):
+        gt.ingest(0, _payload(
+            100 + tick,
+            gauges=[("server_queue_depth", depth, {})]))
+        fired += engine.poll()
+        clock["t"] += 10.0
+        depth += 50        # 5/s >> the 1/s floor
+    assert fired and fired[0]["rule"] == "queue_depth_growth"
+    assert fired[0]["severity"] == "warning"
+
+
+def test_mfu_drop_only_when_floor_configured():
+    gt = GangTelemetry()
+    gt.ingest(0, _payload(100, gauges=[
+        ("mfu", 0.05, {"fn": "train_step", "device_kind": "cpu"})]))
+    # dormant without the knob
+    assert AlertEngine(gt, env=ENV).poll() == []
+    env = dict(ENV, SPARKDL_TPU_ALERT_MFU_MIN="0.2")
+    (rec,) = AlertEngine(gt, env=env).poll()
+    assert rec["rule"] == "mfu_drop"
+    assert rec["detail"]["mfu"] == pytest.approx(0.05)
+    # merged-snapshot rank labels are strings; the record must carry
+    # the INT rank like every event-based rule (the doctor/top line
+    # renders ' rank N' from it)
+    assert rec["rank"] == 0
+
+
+def test_alert_reports_accumulate_across_attempts(tmp_path,
+                                                  monkeypatch):
+    """A regression that fired on attempt 1 must survive a clean
+    attempt 2 into alerts.json (reports accumulate like health
+    summaries; write() merges every attempt's firings)."""
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    gt = GangTelemetry()
+    fired = {"rule": "step_time_regression", "severity": "critical",
+             "rank": 1, "ts": 1.0, "detail": {"rank": 1}}
+    gt.add_alert_report({"schema": "sparkdl_tpu.observe.alerts/1",
+                         "enabled": True, "rules": [],
+                         "alerts": [fired]})
+    gt.add_alert_report({"schema": "sparkdl_tpu.observe.alerts/1",
+                         "enabled": True, "rules": [],
+                         "alerts": []})
+    paths = gt.write(str(tmp_path / "out"))
+    doc = json.loads(open(paths["alerts.json"]).read())
+    assert doc["attempts"] == 2
+    assert [a["rule"] for a in doc["alerts"]] == [
+        "step_time_regression"]
+
+
+def test_format_alert_line_shared_rendering():
+    from sparkdl_tpu.observe.alerts import format_alert_line
+
+    line = format_alert_line({
+        "rule": "heartbeat_gap", "severity": "warning", "rank": 3,
+        "detail": {"rank": 3, "beat_age_s": 9.0, "warn_at_s": 5.0}})
+    assert line == ("[warning] heartbeat_gap rank 3: "
+                    "beat_age_s=9.0, warn_at_s=5.0")
+    assert format_alert_line(
+        {"rule": "queue_depth_growth", "severity": "warning",
+         "rank": None, "detail": {}}
+    ) == "[warning] queue_depth_growth"
+
+
+def test_firing_emits_instant_and_counter(monkeypatch, tmp_path):
+    """The wire contract: a firing lands on the driver timeline as a
+    typed alert.* instant and bumps gang_alerts_total{rule,severity}
+    — both behind the telemetry latch."""
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    env = dict(ENV, SPARKDL_TPU_ALERT_STEP_BASELINE_S="0.01")
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, env=env)
+    gt.ingest(0, _payload(100, events=_steps(time.time() - 5,
+                                             [0.1] * 5)))
+    engine.poll()
+    events = observe.timeline().drain()
+    (instant,) = [e for e in events
+                  if e["name"] == "alert.step_time_regression"]
+    assert instant["cat"] == "alert"
+    assert instant["args"]["severity"] == "critical"
+    assert observe.metrics().counter(
+        "gang_alerts_total", rule="step_time_regression",
+        severity="critical").value == 1
+
+
+# -- acceptance: the injected-slowdown gang ----------------------------------
+
+
+def _slowdown_main(n_fast, n_slow, fast_s, slow_s):
+    import time as _time
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.parallel.train import instrument_step
+
+    hvd.init()
+
+    def step(i):
+        _time.sleep(fast_s if i < n_fast else slow_s)
+        return i
+
+    stepped = instrument_step(step)
+    for i in range(n_fast + n_slow):
+        stepped(i)
+    return hvd.rank()
+
+
+@pytest.mark.gang
+def test_injected_slowdown_fires_exactly_step_time_regression(
+        monkeypatch, tmp_path):
+    """Acceptance: a mid-run slowdown fires the step-time-regression
+    rule and ONLY it — alert.* instant on the merged timeline,
+    counter in metrics.prom, entry in alerts.json, rendered by
+    observe.doctor."""
+    from sparkdl import HorovodRunner
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("SPARKDL_TPU_TELEMETRY_FLUSH_S", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_ALERTS", "1")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_CHECK_S", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_MIN_STEPS", "3")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_WINDOW_S", "3")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_STEP_FACTOR", "2.0")
+    observe._reset_for_tests()
+
+    HorovodRunner(np=-2).run(
+        _slowdown_main, n_fast=12, n_slow=12,
+        fast_s=0.05, slow_s=0.35)
+
+    (run_dir,) = glob.glob(str(tmp_path / "run-*"))
+
+    # 1. alerts.json: the regression, and only the regression
+    alerts = json.loads(
+        open(os.path.join(run_dir, "alerts.json")).read())
+    fired = alerts["alerts"]
+    assert fired, "the injected slowdown never fired the alert"
+    assert {a["rule"] for a in fired} == {"step_time_regression"}
+    assert all(a["severity"] == "critical" for a in fired)
+    detail = fired[0]["detail"]
+    assert detail["median_step_s"] > 2.0 * detail["baseline_step_s"]
+
+    # 2. counter in the merged metrics.prom (driver series)
+    prom = open(os.path.join(run_dir, "metrics.prom")).read()
+    assert ('gang_alerts_total{rank="driver",'
+            'rule="step_time_regression",severity="critical"}'
+            in prom)
+
+    # 3. typed instant on the merged timeline (driver lane 0)
+    trace = json.loads(
+        open(os.path.join(run_dir, "timeline.json")).read())
+    instants = [e for e in trace["traceEvents"]
+                if e.get("name") == "alert.step_time_regression"]
+    assert instants and all(e["pid"] == 0 for e in instants)
+
+    # 4. the doctor renders the alerts section, artifact-only
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run_dir],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "alerts:" in proc.stdout
+    assert "step_time_regression" in proc.stdout
+    assert proc.returncode == 0     # a slowdown is not a hang

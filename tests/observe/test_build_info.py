@@ -1,0 +1,91 @@
+"""Build-info correlation (ISSUE 14 satellite): every export surface
+stamps ``build_info{git_sha,jax_version,device_kind} 1`` so scrapes
+and ledger lines join on sha without guessing."""
+
+import importlib
+
+import pytest
+
+# sparkdl_tpu.observe.metrics the MODULE — the package facade's
+# metrics() accessor shadows the submodule attribute
+metrics_mod = importlib.import_module("sparkdl_tpu.observe.metrics")
+from sparkdl_tpu.observe.metrics import (  # noqa: E402
+    Registry,
+    build_info_labels,
+    ensure_build_info,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_labels():
+    metrics_mod._reset_build_info_for_tests()
+    yield
+    metrics_mod._reset_build_info_for_tests()
+
+
+def test_labels_shape_and_caching():
+    labels = build_info_labels()
+    assert set(labels) == {"git_sha", "jax_version", "device_kind"}
+    # this repo is a checkout: the sha is real, and it is what ledger
+    # lines carry (observe.perf.git_sha), so the join key matches
+    from sparkdl_tpu.observe.perf import git_sha
+
+    assert labels["git_sha"] == (git_sha() or "none")
+    assert build_info_labels() == labels     # cached, stable
+
+
+def test_ensure_build_info_stamps_constant_gauge():
+    reg = Registry()
+    labels = ensure_build_info(reg)
+    out = reg.to_prometheus()
+    assert "# TYPE build_info gauge" in out
+    assert f'git_sha="{labels["git_sha"]}"' in out
+    assert out.count("build_info{") == 1
+    # idempotent: re-stamping never duplicates the series
+    ensure_build_info(reg)
+    assert reg.to_prometheus().count("build_info{") == 1
+
+
+def test_plain_registries_stay_unstamped():
+    """Injection is per export surface, not inside snapshot(): a raw
+    Registry renders exactly what its caller put in it."""
+    reg = Registry()
+    reg.counter("c_total").inc()
+    assert "build_info" not in reg.to_prometheus()
+
+
+def test_fleet_metrics_carry_build_info_and_replica_split():
+    """The fleet /metrics surface: build_info plus the ISSUE 14
+    per-replica queued/in-flight gauges (replica state used to be
+    visible only through restart counters)."""
+    from sparkdl_tpu.models.fleet import FleetFrontend
+
+    class FakeEngine:
+        telemetry = None
+        finish_reasons = {}
+        logprobs = {}
+
+        def submit(self, *a, **k):
+            raise AssertionError("not exercised")
+
+        def run(self, **k):
+            return {}
+
+        def abort_requests(self):
+            pass
+
+    fleet = FleetFrontend(FakeEngine, replicas=2, max_queue=4).start()
+    try:
+        fleet._sample_gauges()
+        out = fleet.metrics.to_prometheus()
+        assert "build_info{" in out
+        for replica in ("0", "1"):
+            assert (f'fleet_replica_queue_depth{{replica="{replica}"}}'
+                    in out)
+            assert (f'fleet_replica_inflight{{replica="{replica}"}}'
+                    in out)
+        states = fleet.replica_states()
+        assert all(s["queued"] == 0 and s["inflight"] == 0
+                   for s in states)
+    finally:
+        fleet.close()

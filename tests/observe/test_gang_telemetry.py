@@ -186,9 +186,13 @@ def test_control_plane_round_trip_in_real_gang(monkeypatch, tmp_path):
 def test_gang_without_telemetry_writes_nothing(monkeypatch, tmp_path):
     """Off by default: no env, no run dirs, no TELEMETRY frames, and
     the worker mains see the zero-overhead path."""
+    import threading
+
     from sparkdl import HorovodRunner
 
     monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+    monkeypatch.delenv("SPARKDL_TPU_STATUSZ_PORT", raising=False)
+    monkeypatch.delenv("SPARKDL_TPU_ALERTS", raising=False)
     observe._reset_for_tests()
     result = HorovodRunner(np=-2).run(_instrumented_main, n_steps=1)
     assert result["telemetry_on"] is False
@@ -197,6 +201,12 @@ def test_gang_without_telemetry_writes_nothing(monkeypatch, tmp_path):
     # (ISSUE 5: "with SPARKDL_TPU_TELEMETRY_DIR unset, heartbeats
     # stay fully disabled")
     assert "sparkdl-tpu-heartbeat" not in result["threads"]
+    # ...and the ISSUE 14 live tier: no statusz thread/socket on the
+    # driver and none in the workers without the env
+    assert not any(t.name.startswith("sparkdl-tpu-statusz")
+                   for t in threading.enumerate())
+    assert not any(n.startswith("sparkdl-tpu-statusz")
+                   for n in result["threads"])
 
 
 def test_second_launch_does_not_inherit_driver_counters(

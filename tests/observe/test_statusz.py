@@ -1,0 +1,344 @@
+"""Live gang status endpoint (ISSUE 14 tentpole): the statusz latch,
+the three endpoints over synthetic telemetry, the fleet table, and —
+the real thing — a 2-rank gang scraped MID-RUN."""
+
+import glob
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe import statusz as statusz_mod
+from sparkdl_tpu.observe.aggregate import GangTelemetry
+from sparkdl_tpu.observe.health import HangDetector
+from sparkdl_tpu.observe.metrics import Registry
+from sparkdl_tpu.observe.statusz import (
+    StatuszServer,
+    maybe_start_statusz,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    statusz_mod._reset_fleets_for_tests()
+    yield
+    observe._reset_for_tests()
+    statusz_mod._reset_fleets_for_tests()
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _payload(pid, counters=(), gauges=(), events=()):
+    reg = Registry()
+    for name, value in counters:
+        reg.counter(name).inc(value)
+    for name, value in gauges:
+        reg.gauge(name).set(value)
+    return {"pid": pid, "host": "hostA", "metrics": reg.snapshot(),
+            "events": list(events)}
+
+
+def _step_event(ts_s, dur_s, step, phase="execute"):
+    return {"name": "train_step", "cat": "train", "ph": "X",
+            "ts": int(ts_s * 1e6), "dur": int(dur_s * 1e6), "tid": 1,
+            "args": {"step": step, "phase": phase}}
+
+
+# -- the latch (zero threads / sockets without the env) ----------------------
+
+
+def test_latch_no_env_no_server(monkeypatch):
+    monkeypatch.delenv(statusz_mod.STATUSZ_PORT_ENV, raising=False)
+    before = {t.name for t in threading.enumerate()}
+    assert maybe_start_statusz(GangTelemetry(), num_workers=2) is None
+    after = {t.name for t in threading.enumerate()}
+    assert before == after
+    assert not any(n.startswith("sparkdl-tpu-statusz") for n in after)
+
+
+def test_latch_no_telemetry_no_server(monkeypatch):
+    monkeypatch.setenv(statusz_mod.STATUSZ_PORT_ENV, "0")
+    assert maybe_start_statusz(None, num_workers=2) is None
+
+
+def test_latch_bad_port_raises(monkeypatch):
+    monkeypatch.setenv(statusz_mod.STATUSZ_PORT_ENV, "not-a-port")
+    with pytest.raises(ValueError, match="STATUSZ_PORT"):
+        maybe_start_statusz(GangTelemetry(), num_workers=2)
+
+
+def test_bind_failure_degrades_to_none(monkeypatch):
+    """A taken port must not fail the launch — the gang matters more
+    than its dashboard."""
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    try:
+        monkeypatch.setenv(statusz_mod.STATUSZ_PORT_ENV,
+                           str(blocker.getsockname()[1]))
+        assert maybe_start_statusz(GangTelemetry(),
+                                   num_workers=2) is None
+    finally:
+        blocker.close()
+
+
+# -- endpoints over synthetic telemetry --------------------------------------
+
+
+def test_metrics_endpoint_serves_live_merged_prometheus():
+    gt = GangTelemetry()
+    gt.ingest(0, _payload(100, counters=[("steps_total", 3)]))
+    server = StatuszServer(gt, num_workers=1).start()
+    try:
+        base = f"http://{server.address}"
+        body1 = _get(f"{base}/metrics")
+        assert 'steps_total{rank="0"} 3' in body1
+        # live, not a one-shot artifact: a newer cumulative snapshot
+        # changes the NEXT scrape
+        gt.ingest(0, _payload(100, counters=[("steps_total", 7)]))
+        body2 = _get(f"{base}/metrics")
+        assert 'steps_total{rank="0"} 7' in body2
+        assert body1 != body2
+        # build-info correlation rides the same scrape
+        assert "build_info{" in body2 and "git_sha=" in body2
+    finally:
+        server.close()
+
+
+def test_statusz_endpoint_ranks_perf_and_supervisor():
+    clock = {"t": 100.0}
+    detector = HangDetector(2, stall_s=30,
+                            clock=lambda: clock["t"])
+    detector.observe_beat(0, {"step": 5, "progress": 11,
+                              "collective": "reduce",
+                              "hbm": {"in_use": 1024}})
+    clock["t"] = 102.0
+    gt = GangTelemetry()
+    now = time.time()
+    gt.ingest(0, _payload(100, events=[
+        _step_event(now - 3, 0.1, 1),
+        _step_event(now - 2, 0.1, 2),
+        _step_event(now - 1, 0.3, 3),
+    ]))
+    server = StatuszServer(gt, detector=detector,
+                           num_workers=2).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        assert doc["gang"]["num_workers"] == 2
+        # rank 0: live heartbeat state with beat age on the detector
+        # clock; rank 1 never beat -> unseen, not absent
+        assert doc["ranks"]["0"]["step"] == 5
+        assert doc["ranks"]["0"]["collective"] == "reduce"
+        assert doc["ranks"]["0"]["beat_age_s"] == pytest.approx(2.0)
+        assert doc["ranks"]["1"]["state"] == "unseen"
+        # rolling attribution window over the journal
+        p = doc["perf"]["per_rank"]["0"]
+        assert p["steps"] == 3
+        assert p["median_step_s"] == pytest.approx(0.1, rel=1e-3)
+        assert doc["supervisor"]["attempts_total"] == 0
+        assert doc["alerts"] == {"enabled": False, "fired": []}
+        assert "fleet" not in doc
+    finally:
+        server.close()
+
+
+def test_events_endpoint_streams_sse_tail():
+    gt = GangTelemetry()
+    gt.ingest(1, _payload(100, events=[
+        {"name": "worker.start", "cat": "worker", "ph": "i",
+         "ts": 1, "tid": 1, "args": {}}]))
+    server = StatuszServer(gt, num_workers=2).start()
+    try:
+        req = urllib.request.urlopen(
+            f"http://{server.address}/events", timeout=5)
+        line = req.readline().decode()
+        assert line.startswith("id: 1")
+        data = req.readline().decode()
+        assert data.startswith("data: ")
+        ev = json.loads(data[len("data: "):])
+        assert ev["rank"] == 1
+        assert ev["event"]["name"] == "worker.start"
+        req.close()
+    finally:
+        server.close()
+
+
+def test_fleet_registration_renders_replica_table():
+    class FakeFleet:
+        address = ("127.0.0.1", 9999)
+        max_queue = 8
+        _restarts = 1
+
+        def replica_states(self):
+            return [{"replica": 0, "alive": True, "depth": 3,
+                     "queued": 1, "inflight": 2,
+                     "restart_cause": None}]
+
+        def queue_depth(self):
+            return 3
+
+    fleet = FakeFleet()
+    statusz_mod.register_fleet(fleet)
+    gt = GangTelemetry()
+    server = StatuszServer(gt, num_workers=1).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        (entry,) = doc["fleet"]
+        assert entry["restarts"] == 1
+        assert entry["replicas"][0]["queued"] == 1
+        assert entry["replicas"][0]["inflight"] == 2
+    finally:
+        server.close()
+    # a CLOSED fleet leaves the table immediately, even while the
+    # caller still holds the variable (close() unregisters; the
+    # weakref is only the backstop for callers that never close)
+    statusz_mod.unregister_fleet(fleet)
+    assert statusz_mod.fleet_status() is None
+    # re-registration is idempotent: start();start() is one row
+    statusz_mod.register_fleet(fleet)
+    statusz_mod.register_fleet(fleet)
+    assert len(statusz_mod.fleet_status()) == 1
+
+
+# -- the real thing: scraped mid-run -----------------------------------------
+
+
+def _slow_stepped_main(n_steps, sleep_s):
+    import threading as _threading
+    import time as _time
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.parallel.train import instrument_step
+
+    hvd.init()
+
+    def step(i):
+        _time.sleep(sleep_s)
+        return i
+
+    stepped = instrument_step(step)
+    for i in range(n_steps):
+        stepped(i)
+    return {"rank": hvd.rank(),
+            "threads": sorted(t.name for t in
+                              _threading.enumerate())}
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _MidRunScraper(threading.Thread):
+    """Polls /metrics and /statusz while the gang (running on the
+    main thread) is mid-flight; keeps the evidence for the test."""
+
+    def __init__(self, base, deadline_s=60.0):
+        super().__init__(name="test-statusz-scraper", daemon=True)
+        self.base = base
+        self.deadline = time.monotonic() + deadline_s
+        self.metrics_bodies = []
+        self.statusz_with_all_ranks = None
+        self.error = None
+
+    def run(self):
+        try:
+            while time.monotonic() < self.deadline:
+                try:
+                    body = _get(f"{self.base}/metrics", timeout=2)
+                except OSError:
+                    time.sleep(0.1)
+                    continue
+                if "train_step_total" in body and (
+                        not self.metrics_bodies
+                        or body != self.metrics_bodies[-1]):
+                    self.metrics_bodies.append(body)
+                try:
+                    doc = json.loads(
+                        _get(f"{self.base}/statusz", timeout=2))
+                except (OSError, ValueError):
+                    doc = None
+                if doc and self.statusz_with_all_ranks is None:
+                    ranks = doc.get("ranks") or {}
+                    if all(
+                        isinstance(ranks.get(str(r), {}).get("step"),
+                                   int)
+                        for r in (0, 1)
+                    ):
+                        self.statusz_with_all_ranks = doc
+                if (len(self.metrics_bodies) >= 2
+                        and self.statusz_with_all_ranks is not None):
+                    return
+                time.sleep(0.15)
+        except Exception as e:   # surfaced by the main thread
+            self.error = e
+
+
+@pytest.mark.gang
+def test_statusz_scraped_mid_run_and_clean_run_fires_no_alert(
+        monkeypatch, tmp_path):
+    """Acceptance: two GET /metrics snapshots taken mid-run differ
+    (counters advanced) and /statusz shows every rank's current step.
+    Alerts are armed with steady steps — the clean run must fire none
+    and still leave an (empty) alerts.json behind."""
+    from sparkdl import HorovodRunner
+
+    port = _free_port()
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("SPARKDL_TPU_TELEMETRY_FLUSH_S", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_STATUSZ_PORT", str(port))
+    monkeypatch.setenv("SPARKDL_TPU_ALERTS", "1")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_CHECK_S", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_MIN_STEPS", "3")
+    observe._reset_for_tests()
+
+    scraper = _MidRunScraper(f"http://127.0.0.1:{port}")
+    scraper.start()
+    result = HorovodRunner(np=-2).run(
+        _slow_stepped_main, n_steps=30, sleep_s=0.1)
+    scraper.join(timeout=10)
+    assert scraper.error is None
+
+    # two mid-run scrapes with advancing counters
+    assert len(scraper.metrics_bodies) >= 2, (
+        "never caught two differing /metrics scrapes mid-run")
+    first, last = scraper.metrics_bodies[0], scraper.metrics_bodies[-1]
+    assert first != last
+    assert "train_step_total" in first and "build_info{" in last
+
+    # /statusz showed every rank's current step mid-run
+    doc = scraper.statusz_with_all_ranks
+    assert doc is not None, "/statusz never showed both ranks' steps"
+    assert doc["gang"]["num_workers"] == 2
+    assert doc["alerts"]["enabled"] is True
+
+    # the server is torn down with the attempt (no leaked thread)...
+    assert not any(t.name.startswith("sparkdl-tpu-statusz")
+                   for t in threading.enumerate())
+    # ...and the worker side never grew a statusz thread at all
+    # (the endpoint is driver-side only)
+    assert not any(n.startswith("sparkdl-tpu-statusz")
+                   for n in result["threads"])
+
+    # clean-run false-positive guard: rules armed, nothing fired,
+    # and the artifact SAYS so
+    (run_dir,) = glob.glob(str(tmp_path / "run-*"))
+    alerts = json.loads(
+        open(os.path.join(run_dir, "alerts.json")).read())
+    assert alerts["enabled"] is True
+    assert alerts["alerts"] == []
+    assert {r["rule"] for r in alerts["rules"]} >= {
+        "step_time_regression", "heartbeat_gap", "hbm_high_water"}

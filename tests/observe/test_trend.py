@@ -1,0 +1,124 @@
+"""Ledger trend viewer (ISSUE 14 satellite): per-metric trajectory
+rows with sha + p50/p99 + delta-vs-previous, committed baselines
+beside the trajectory, and the --format json CI contract."""
+
+import json
+
+import pytest
+
+from sparkdl_tpu.observe.trend import (
+    build_trend,
+    load_baselines,
+    main,
+    render_text,
+)
+
+
+def _entry(sha, value, *, metric="cpu_proxy_tokens_per_sec",
+           p99=None, ts="2026-08-01T00:00:00Z", unit="tok/s",
+           hib=None):
+    m = {"value": value, "p50": value, "unit": unit}
+    if p99 is not None:
+        m["p99"] = p99
+    if hib is not None:
+        m["higher_is_better"] = hib
+    return {"schema": 1, "ts": ts, "git_sha": sha, "host": "h/x/8",
+            "device_kind": "cpu", "bench": "cpu-proxy",
+            "metrics": {metric: m}}
+
+
+def test_build_trend_deltas_and_direction():
+    entries = [
+        _entry("aaa1111", 1000.0, p99=1100.0),
+        _entry("bbb2222", 1200.0, p99=1300.0),
+        _entry("ccc3333", 1100.0, p99=1150.0),
+    ]
+    trend = build_trend(entries)
+    rows = trend["metrics"]["cpu_proxy_tokens_per_sec"]["records"]
+    assert [r["git_sha"] for r in rows] == [
+        "aaa1111", "bbb2222", "ccc3333"]
+    assert rows[0]["delta_vs_prev"] is None
+    assert rows[1]["delta_vs_prev"] == pytest.approx(0.2)
+    assert rows[2]["delta_vs_prev"] == pytest.approx(-1 / 12, rel=1e-3)
+    assert rows[2]["p99"] == 1150.0
+
+
+def test_lower_is_better_metrics_invert_deltas():
+    entries = [
+        _entry("a", 0.10, metric="serve_ttft_p99_seconds", hib=False),
+        _entry("b", 0.05, metric="serve_ttft_p99_seconds", hib=False),
+    ]
+    trend = build_trend(entries)
+    entry = trend["metrics"]["serve_ttft_p99_seconds"]
+    assert entry["higher_is_better"] is False
+    # latency halved = +50% improvement, not -50%
+    assert entry["records"][1]["delta_vs_prev"] == pytest.approx(0.5)
+
+
+def test_baselines_render_beside_trajectory(tmp_path):
+    base = tmp_path / "BASELINE.json"
+    base.write_text(json.dumps({
+        "published": {"cpu_proxy_tokens_per_sec": 1000.0,
+                      "_frozen": "not-a-metric",
+                      "note": "strings skipped"},
+    }))
+    baselines = load_baselines([str(base), str(tmp_path / "absent")])
+    assert baselines == {"cpu_proxy_tokens_per_sec": {
+        "value": 1000.0, "source": "BASELINE.json"}}
+    trend = build_trend([_entry("a", 1100.0)], baselines=baselines)
+    entry = trend["metrics"]["cpu_proxy_tokens_per_sec"]
+    assert entry["baseline"]["value"] == 1000.0
+    assert entry["newest_vs_baseline"] == pytest.approx(0.1)
+    text = render_text(trend)
+    assert "committed baseline [BASELINE.json]: 1000" in text
+    assert "aaa" not in text     # shas rendered are the entries' own
+
+
+def test_history_record_shaped_baseline_loads():
+    """serve_baseline.json is a promoted ledger LINE (a ``metrics``
+    map), not a ``published`` map — both committed shapes must
+    load."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    serve = os.path.join(repo, "benchmarks", "results",
+                         "serve_baseline.json")
+    baselines = load_baselines([serve])
+    assert baselines, "committed serve_baseline.json loaded nothing"
+    assert all(isinstance(b["value"], float)
+               and b["source"] == "serve_baseline.json"
+               for b in baselines.values())
+
+
+def test_metric_filter_and_last():
+    entries = [_entry(f"sha{i}", 100.0 + i) for i in range(6)]
+    entries.append(_entry("other", 5.0, metric="other_metric"))
+    trend = build_trend(entries, only={"cpu_proxy_tokens_per_sec"},
+                        last=2)
+    assert list(trend["metrics"]) == ["cpu_proxy_tokens_per_sec"]
+    rows = trend["metrics"]["cpu_proxy_tokens_per_sec"]["records"]
+    assert [r["git_sha"] for r in rows] == ["sha4", "sha5"]
+    # the window's first row has no predecessor IN VIEW
+    assert rows[0]["delta_vs_prev"] is None
+
+
+def test_cli_json_contract(tmp_path, capsys):
+    history = tmp_path / "history.jsonl"
+    with open(history, "w") as f:
+        for e in (_entry("a", 1000.0), _entry("b", 1300.0)):
+            f.write(json.dumps(e) + "\n")
+    rc = main(["--history", str(history), "--baseline",
+               str(tmp_path / "nope.json"), "--format", "json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "sparkdl_tpu.observe.trend/1"
+    rows = doc["metrics"]["cpu_proxy_tokens_per_sec"]["records"]
+    assert rows[1]["delta_vs_prev"] == pytest.approx(0.3)
+    assert doc["history_path"] == str(history)
+
+
+def test_cli_empty_ledger_exits_2(tmp_path, capsys):
+    rc = main(["--history", str(tmp_path / "none.jsonl")])
+    assert rc == 2
+    assert "no ledger records" in capsys.readouterr().out
