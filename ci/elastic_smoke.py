@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""CI elastic-resume smoke (ISSUE 15): chaos-kill a rank in a 2-rank
+gang whose train state is sharded over the gang mesh, and FAIL the
+build unless the whole elastic loop closes: the supervisor relaunches
+at np=1 with the gang actually resized, the restart context carries
+the recorded source axes + the shrink_mesh-derived target axes, the
+checkpoint restores bit-exact-modulo-resharding onto the shrunken
+mesh within the reshard plan's high-water accounting, training
+completes on the control run's exact trajectory,
+``gang_reshards_total`` lands in the run dir's metrics, and
+``observe.doctor`` renders the reshard section from the artifacts
+alone. The run dir is uploaded by the workflow.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/elastic_smoke.py``
+(defaults the dir to ``./elastic-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Runnable as `python ci/elastic_smoke.py` from a checkout: the script
+# dir (ci/) is sys.path[0], the package root is one up.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEADLINE_S = 300
+TOTAL_STEPS = 5
+KILL_STEP = 2
+
+
+def _elastic_main(ckpt_dir, total_steps):
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.parallel.mesh import make_mesh_from_axes
+    from sparkdl_tpu.parallel.sharding import full_host_value
+    from sparkdl_tpu.utils.chaos import chaos_step
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    hvd.init()
+    ctx = restart_context()
+    axes = dict(ctx.target_axes or {"data": hvd.size()})
+    mesh = make_mesh_from_axes(axes)
+    host = np.ones((8, 4), np.float32)
+    w = jax.make_array_from_callback(
+        host.shape, NamedSharding(mesh, P("data", None)),
+        lambda idx: host[idx])
+    ckpt = TrainCheckpointer(ckpt_dir)
+    step_fn = jax.jit(lambda a, g: (a - 0.01 * g).astype(np.float32))
+    start = 0
+    restored_w = None
+    reshard = None
+    if ctx.resume_step is not None:
+        w = ckpt.restore(ctx.resume_step, target_mesh=mesh)["w"]
+        reshard = dict(ckpt.last_reshard) if ckpt.last_reshard else None
+        restored_w = full_host_value(w).tolist()
+        start = ctx.resume_step + 1
+    try:
+        for step in range(start, total_steps):
+            g = hvd.allreduce(
+                np.full((8, 4), float(step + 1), np.float32),
+                op=hvd.Average)
+            w = step_fn(w, np.asarray(g))
+            ckpt.save(step, {"w": w})
+            ckpt.wait_until_finished()
+            hvd.barrier()
+            chaos_step(step)
+    finally:
+        ckpt.close()
+    return {
+        "w": full_host_value(w).tolist(),
+        "attempt": ctx.attempt,
+        "resume_step": ctx.resume_step,
+        "world": hvd.size(),
+        "axes": axes,
+        "restored_w": restored_w,
+        "reshard": reshard,
+    }
+
+
+def _expected(total_steps):
+    """The gang's exact float32 trajectory, recomputed on the driver:
+    the update is elementwise and rank-independent, so the control is
+    arithmetic, not another gang."""
+    import numpy as np
+
+    w = np.ones((8, 4), np.float32)
+    out = {}
+    for step in range(total_steps):
+        g = np.full((8, 4), float(step + 1), np.float32)
+        w = (w - 0.01 * g).astype(np.float32)
+        out[step] = w.tolist()
+    return out
+
+
+def fail(msg):
+    print(f"ELASTIC SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "elastic-artifacts"),
+    )
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    ck = os.path.join(out_dir, "ck")
+    os.environ.update({
+        "SPARKDL_TPU_GANG_MAX_RETRIES": "2",
+        "SPARKDL_TPU_GANG_BACKOFF_BASE": "0.2",
+        "SPARKDL_TPU_GANG_BACKOFF_MAX": "0.5",
+        "SPARKDL_TPU_GANG_RESUME_DIR": ck,
+        "SPARKDL_TPU_GANG_RELAUNCH_NP": "1",
+        "SPARKDL_TPU_ABORT_GRACE": "10",
+        "SPARKDL_TPU_CHAOS_KILL_RANK": "1",
+        "SPARKDL_TPU_CHAOS_KILL_STEP": str(KILL_STEP),
+        "SPARKDL_TPU_CHAOS_ONCE_FILE": os.path.join(
+            out_dir, "one-kill"),
+    })
+
+    from sparkdl import HorovodRunner
+
+    t0 = time.monotonic()
+    result = HorovodRunner(np=-2).run(
+        _elastic_main, ckpt_dir=ck, total_steps=TOTAL_STEPS)
+    elapsed = time.monotonic() - t0
+    print(f"gang result: attempt={result['attempt']} "
+          f"world={result['world']} resume={result['resume_step']} "
+          f"({elapsed:.1f}s)")
+    if elapsed > DEADLINE_S:
+        fail(f"kill + shrink + resume took {elapsed:.0f}s "
+             f"(deadline {DEADLINE_S}s)")
+    if result["attempt"] != 1:
+        fail(f"expected exactly one supervised relaunch, got "
+             f"attempt {result['attempt']}")
+    if result["world"] != 1:
+        fail(f"relaunched gang was not resized to np=1 "
+             f"(world={result['world']})")
+    if result["axes"].get("data") != 1:
+        fail(f"worker did not rebuild the shrunken mesh from the "
+             f"restart context (axes={result['axes']})")
+
+    expected = _expected(TOTAL_STEPS)
+    if result["resume_step"] != KILL_STEP:
+        fail(f"expected resume from step {KILL_STEP}, got "
+             f"{result['resume_step']}")
+    # bit-exact-modulo-resharding: the restored params equal the
+    # pre-kill trajectory, and the finished run stays on its rails
+    if result["restored_w"] != expected[KILL_STEP]:
+        fail("restored params differ from the pre-kill checkpoint "
+             "(not bit-exact-modulo-resharding)")
+    if result["w"] != expected[TOTAL_STEPS - 1]:
+        fail("final params differ from the uninterrupted trajectory")
+    reshard = result["reshard"]
+    if not reshard or reshard.get("direction") != "shrink":
+        fail(f"no shrink reshard recorded in the restore "
+             f"(got {reshard})")
+    if (reshard["high_water_accounted_bytes"]
+            > reshard["restore_high_water_bytes"]):
+        fail("restore accounting exceeded the plan's high-water bound")
+    print(f"reshard: {reshard['source_axes']} -> "
+          f"{reshard['target_axes']}, {reshard['bytes_moved']} bytes "
+          f"moved, high-water {reshard['high_water_accounted_bytes']} "
+          f"within plan {reshard['restore_high_water_bytes']}")
+
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected one run dir under {out_dir}, found {run_dirs}")
+    run = run_dirs[0]
+
+    # the reshard landed in the merged gang metrics
+    try:
+        with open(os.path.join(run, "metrics.prom")) as f:
+            prom = f.read()
+    except OSError as e:
+        fail(f"metrics.prom missing: {e}")
+    if "gang_reshards_total" not in prom:
+        fail("gang_reshards_total missing from the run dir metrics")
+
+    # ... and on the merged timeline
+    try:
+        with open(os.path.join(run, "timeline.json")) as f:
+            events = [e for e in json.load(f)["traceEvents"]
+                      if e.get("ph") != "M"]
+    except (OSError, ValueError, KeyError) as e:
+        fail(f"timeline.json missing or malformed: {e}")
+    names = {e.get("name") for e in events}
+    for required in ("gang.reshard", "gang.resume"):
+        if required not in names:
+            fail(f"timeline missing {required!r} "
+                 f"(have {sorted(names)})")
+
+    # observe.doctor renders the reshard section from artifacts alone
+    doctor_env = dict(os.environ)
+    doctor_env["PYTHONPATH"] = (
+        REPO + os.pathsep + doctor_env.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run],
+        capture_output=True, text=True, timeout=120, env=doctor_env,
+    )
+    if r.returncode != 0:
+        fail(f"doctor exit {r.returncode} (expected 0, no hang); "
+             f"stderr: {r.stderr[-400:]}")
+    if "reshard: shrink" not in r.stdout:
+        fail(f"doctor did not render the reshard section:\n"
+             f"{r.stdout[-800:]}")
+    with open(os.path.join(run, "doctor.txt"), "w") as f:
+        f.write(r.stdout)
+    print(r.stdout)
+    print("ELASTIC SMOKE PASSED: kill -> shrink -> resharded resume "
+          "-> bit-exact finish, proven in the artifacts")
+
+
+if __name__ == "__main__":
+    main()

@@ -464,12 +464,55 @@ def reshard_plan(param_info, source_axes, target_axes, *,
     )
 
 
+def param_info_from_sidecar(doc):
+    """:class:`~sparkdl_tpu.analysis.core.ParamInfo` list from a
+    checkpoint sharding-tree sidecar
+    (:data:`sparkdl_tpu.utils.checkpoint.SHARDING_TREE_SCHEMA`) — the
+    jax-free inverse of
+    :func:`sparkdl_tpu.parallel.sharding.sharding_tree_info`, so
+    :func:`reshard_plan` can price a restore straight from what the
+    failed run persisted."""
+    from sparkdl_tpu.analysis.core import ParamInfo
+    from sparkdl_tpu.utils.checkpoint import sidecar_mesh_axes
+
+    sizes = sidecar_mesh_axes(doc)
+    mesh_axes = tuple(sorted(sizes.items()))
+    out = []
+    for p in doc.get("params") or []:
+        spec = tuple(
+            tuple(str(n) for n in (dims or ()))
+            for dims in (p.get("spec") or ())
+        )
+        out.append(ParamInfo(
+            path=str(p.get("path", "")),
+            shape=tuple(int(d) for d in p.get("shape") or ()),
+            dtype=str(p.get("dtype", "float32")),
+            # Axis names absent from the recorded mesh_axes count as
+            # UNSHARDED (size 1): the sidecar always records its mesh,
+            # so an unknown name is a malformed document, and inventing
+            # a split for it would corrupt the plan's byte math.
+            sharded_axes=tuple(
+                n for dims in spec for n in dims
+                if sizes.get(n, 1) > 1
+            ),
+            spec=spec,
+            mesh_axes=mesh_axes,
+        ))
+    return out
+
+
 def shrink_mesh(source_axes, target_np):
     """Re-derive a mesh for ``target_np`` devices from ``source_axes``:
     model/seq (the axes that change the program) are preserved, the
     data-like axes (data, fsdp) absorb the change — fsdp kept when the
     remainder still divides by it, else collapsed into data. Returns
-    ``(axes_dict, None)`` or ``(None, reason)``."""
+    ``(axes_dict, None)`` or ``(None, reason)``.
+
+    Handles both directions of the elastic arc: ``target_np`` smaller
+    than the source world (preemption shrink) or larger (the grow-back
+    leg once capacity returns). A shrink that kept fsdp intact
+    round-trips axis-exact through the matching grow — pinned in
+    ``tests/analysis/test_comms.py``."""
     model = int(source_axes.get("model", 1))
     seq = int(source_axes.get("seq", 1))
     fixed = model * seq

@@ -11,13 +11,16 @@ where driver == worker) it is printed directly.
 """
 
 import collections
+import json as _json
 import os as _os
 
 MAX_LOG_MESSAGE_LENGTH = 4000  # reference sparkdl/horovod/__init__.py:23
 
 
 RestartContext = collections.namedtuple(
-    "RestartContext", ["attempt", "resume_step"]
+    "RestartContext",
+    ["attempt", "resume_step", "source_axes", "target_axes"],
+    defaults=[None, None],
 )
 _resume_instant_emitted = False  # one gang.resume marker per process
 RestartContext.__doc__ = """The gang supervisor's restart context.
@@ -27,8 +30,27 @@ first launch — unmodified mains can ignore the context entirely).
 ``resume_step``: the latest :class:`~sparkdl_tpu.utils.checkpoint.
 TrainCheckpointer` step committed under
 ``SPARKDL_TPU_GANG_RESUME_DIR`` when this attempt launched, or None
-when no checkpoint exists (start from scratch). See
-``docs/fault_tolerance.rst`` for the resume contract."""
+when no checkpoint exists (start from scratch).
+``source_axes`` / ``target_axes``: on an elastic relaunch
+(``SPARKDL_TPU_GANG_RELAUNCH_NP``), the mesh axis sizes the resume
+checkpoint was laid out on and the axes ``shrink_mesh`` derived for
+the new world — mains rebuild the surviving mesh from
+``target_axes`` (e.g. via
+:func:`sparkdl_tpu.parallel.mesh.make_mesh_from_axes`) and pass it to
+``TrainCheckpointer.restore(..., target_mesh=...)``; both are None
+outside an elastic relaunch. See ``docs/fault_tolerance.rst`` for the
+resume contract."""
+
+
+def _axes_env(name):
+    raw = _os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        doc = _json.loads(raw)
+        return {str(k): int(v) for k, v in doc.items()}
+    except (ValueError, TypeError, AttributeError):
+        return None
 
 
 def restart_context():
@@ -50,6 +72,8 @@ def restart_context():
     calling it unconditionally is always safe.
     """
     from sparkdl_tpu.horovod.supervisor import (
+        RESHARD_SOURCE_AXES_ENV,
+        RESHARD_TARGET_AXES_ENV,
         RESTART_ATTEMPT_ENV,
         RESUME_STEP_ENV,
     )
@@ -58,6 +82,8 @@ def restart_context():
 
     attempt = int(_os.environ.get(RESTART_ATTEMPT_ENV, "0"))
     step = _os.environ.get(RESUME_STEP_ENV)
+    source_axes = _axes_env(RESHARD_SOURCE_AXES_ENV)
+    target_axes = _axes_env(RESHARD_TARGET_AXES_ENV)
     if attempt > 0 and not _resume_instant_emitted:
         # The "resumed" beat of the gang timeline: a relaunched worker
         # reading its restart context is the moment recovery actually
@@ -70,8 +96,12 @@ def restart_context():
         observe.instant(
             "gang.resume", cat="supervisor", attempt=attempt,
             resume_step=int(step) if step is not None else None,
+            source_axes=source_axes, target_axes=target_axes,
         )
-    return RestartContext(attempt, int(step) if step is not None else None)
+    return RestartContext(
+        attempt, int(step) if step is not None else None,
+        source_axes, target_axes,
+    )
 
 
 def log_to_driver(message):

@@ -568,11 +568,34 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
 
     spec_placement = Placement.from_env(os.environ)
     num_workers, mode, total_slots = _resolve_num_workers(np, spec_placement)
+    # Elastic relaunch (SPARKDL_TPU_GANG_RELAUNCH_NP): the supervisor
+    # cleared this target through the reshard pre-flight and shipped it
+    # in the restart context — the relaunched gang is RESIZED to it,
+    # not just told about it. Cluster mode re-resolves so slot
+    # accounting (and the np-exceeds-total fail-fast) follows the new
+    # world; local mode spawns exactly that many subprocesses.
+    from sparkdl_tpu.horovod.supervisor import (
+        RELAUNCH_NP_ENV,
+        record_attempt_world,
+    )
+
+    relaunch_np = int((extra_env or {}).get(RELAUNCH_NP_ENV) or 0)
+    if relaunch_np and relaunch_np != num_workers:
+        if mode == "local":
+            num_workers = relaunch_np
+        else:
+            num_workers, mode, total_slots = _resolve_num_workers(
+                relaunch_np, spec_placement)
+        logger.info(
+            "elastic relaunch: gang world resized to np=%d "
+            "(%s mode)", num_workers, mode,
+        )
     if per_rank_kwargs is not None and len(per_rank_kwargs) != num_workers:
         raise ValueError(
             f"per_rank_kwargs has {len(per_rank_kwargs)} entries for a "
             f"gang of {num_workers}"
         )
+    record_attempt_world(num_workers)
 
     # Remote-transport availability is knowable NOW — before the slot
     # claim (which can wait minutes for busy slots) and before any

@@ -70,6 +70,7 @@ untouched; see ``docs/fault_tolerance.rst``):
 """
 
 import dataclasses
+import json
 import logging
 import os
 import random
@@ -99,6 +100,28 @@ RELAUNCH_NP_ENV = "SPARKDL_TPU_GANG_RELAUNCH_NP"
 # attempt counters).
 RESTART_ATTEMPT_ENV = "SPARKDL_TPU_RESTART_ATTEMPT"
 RESUME_STEP_ENV = "SPARKDL_TPU_RESUME_STEP"
+# Elastic relaunch mesh contract (JSON axis-size dicts): the recorded
+# source mesh axes of the resume checkpoint and the target axes
+# shrink_mesh derived for RELAUNCH_NP — shipped so relaunched worker
+# mains rebuild the shrunken (or regrown) mesh without guessing.
+RESHARD_SOURCE_AXES_ENV = "SPARKDL_TPU_RESHARD_SOURCE_AXES"
+RESHARD_TARGET_AXES_ENV = "SPARKDL_TPU_RESHARD_TARGET_AXES"
+
+# World size of every launch attempt in this driver process, in order
+# (the launcher records each resolved gang size). Feeds the /statusz
+# supervisor section so a shrunken gang is visible in mission control:
+# current attempt's world vs the previous attempt's.
+_attempt_worlds = []
+
+
+def record_attempt_world(num_workers):
+    """Launcher hook: one resolved gang size per launch attempt."""
+    _attempt_worlds.append(int(num_workers))
+
+
+def attempt_world_sizes():
+    """World sizes of this driver's launch attempts, oldest first."""
+    return list(_attempt_worlds)
 
 TRANSIENT = "transient"
 PERMANENT = "permanent"
@@ -407,6 +430,38 @@ def _reshard_preflight(target_np):
     return plan
 
 
+def _reshard_axes(policy, target_np, resume_step):
+    """(source_axes, target_axes) for an elastic relaunch's restart
+    context: source from the registered gang sharding when the driver
+    registered one, else from the resume checkpoint's sharding-tree
+    sidecar (jax-free — readable on the driver between relaunches);
+    target derived via ``shrink_mesh``. ``(None, None)`` when no
+    source mesh is knowable — workers then fall back to their own
+    world-size defaults."""
+    from sparkdl_tpu.analysis.comms import (
+        registered_gang_sharding,
+        shrink_mesh,
+    )
+
+    src = None
+    reg = registered_gang_sharding()
+    if reg is not None:
+        src = dict(reg["source_axes"])
+    if not src and policy.resume_dir and resume_step is not None:
+        from sparkdl_tpu.utils.checkpoint import (
+            load_sharding_tree,
+            sidecar_mesh_axes,
+        )
+
+        doc = load_sharding_tree(policy.resume_dir, resume_step)
+        if doc is not None:
+            src = sidecar_mesh_axes(doc)
+    if not src:
+        return None, None
+    tgt, _reason = shrink_mesh(src, int(target_np))
+    return src, tgt
+
+
 def supervise(launch, policy, _sleep=time.sleep):
     """Run ``launch(extra_env)`` under the retry policy.
 
@@ -421,6 +476,7 @@ def supervise(launch, policy, _sleep=time.sleep):
 
     attempts = []
     attempt = 1
+    del _attempt_worlds[:]  # fresh story per supervised launch
     while True:
         extra_env = {}
         if attempt > 1:
@@ -432,9 +488,19 @@ def supervise(launch, policy, _sleep=time.sleep):
             if target_np is not None:
                 # Cleared by _reshard_preflight before the backoff
                 # that led here; shipped so the relaunched workers see
-                # the elastic target (the launcher honoring it
-                # end-to-end is the elastic-gang arc).
+                # the elastic target — the launcher resizes the gang
+                # to it, and the axes pair below tells worker mains
+                # the exact mesh to rebuild (recorded source layout +
+                # shrink_mesh-derived target).
                 extra_env[RELAUNCH_NP_ENV] = str(target_np)
+                src_axes, tgt_axes = _reshard_axes(
+                    policy, target_np, step)
+                if src_axes:
+                    extra_env[RESHARD_SOURCE_AXES_ENV] = json.dumps(
+                        src_axes, sort_keys=True)
+                if tgt_axes:
+                    extra_env[RESHARD_TARGET_AXES_ENV] = json.dumps(
+                        tgt_axes, sort_keys=True)
         observe.inc("gang_attempts_total")
         observe.instant("gang.attempt", cat="supervisor", attempt=attempt)
         try:

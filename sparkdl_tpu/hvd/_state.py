@@ -50,7 +50,11 @@ def ensure_jax_platform():
     forced = os.environ.get(FORCE_PLATFORM_ENV)
     if forced:
         jax.config.update("jax_platforms", forced)
-        if forced == "cpu":
+        if forced == "cpu" and int(os.environ.get(SIZE_ENV, "1")) > 1:
+            # gloo needs the jax.distributed client, which only a
+            # multi-process world initializes — arming it for a
+            # single-worker gang (np=1, the elastic shrink floor)
+            # would fail CPU backend creation outright.
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 

@@ -415,6 +415,11 @@ def diagnose(run_dir):
         for e in named("gang.failure")
     ]
     resumes = [e.get("args", {}) for e in named("gang.resume")]
+    # Elastic resume: every resharded restore left a gang.reshard
+    # event carrying the recorded→surviving axes, the bytes it moved
+    # and the memory-accounted high water vs the plan's bound — the
+    # whole topology transition, reproducible from artifacts alone.
+    reshards = [e.get("args", {}) for e in named("gang.reshard")]
     hang_causes = [f for f in failures
                    if "hang" in str(f.get("cause", "")).lower()]
     if verdict is None and hang_causes:
@@ -452,6 +457,7 @@ def diagnose(run_dir):
         "ranks": {str(r): ranks[r] for r in sorted(ranks)},
         "failures": failures,
         "resumes": resumes,
+        "reshards": reshards,
         "stack_dumps": {str(r): os.path.basename(p)
                         for r, p in sorted(stack_dumps.items())},
         "flight_recorder_events": {str(r): n
@@ -503,6 +509,26 @@ def render_text(diag):
         steps = ", ".join(str(r.get("resume_step")) for r in diag["resumes"])
         lines.append(f"resumed: {len(diag['resumes'])} relaunch(es) "
                      f"(resume step(s): {steps})")
+    for r in diag.get("reshards") or ():
+        def axes_s(a):
+            return ("{" + ", ".join(f"{k}={v}" for k, v in
+                                    sorted((a or {}).items())) + "}")
+        line = (f"reshard: {r.get('direction')} "
+                f"{axes_s(r.get('source_axes'))} -> "
+                f"{axes_s(r.get('target_axes'))} at step "
+                f"{r.get('step')}: {r.get('params')} param(s) in "
+                f"{r.get('groups')} group(s), "
+                f"{_fmt_bytes(r.get('bytes_moved'))} moved")
+        hw = r.get("high_water_accounted_bytes")
+        bound = r.get("restore_high_water_bytes")
+        if hw is not None:
+            line += f"; restore high-water {_fmt_bytes(hw)}"
+            if bound is not None:
+                line += f" (plan bound {_fmt_bytes(bound)})"
+            hbm = r.get("hbm_bytes")
+            if hbm:
+                line += f" vs HBM {_fmt_bytes(hbm)}"
+        lines.append(line)
     if diag["chaos_injections"]:
         lines.append("chaos injections on the timeline: "
                      + ", ".join(diag["chaos_injections"]))

@@ -293,13 +293,22 @@ class StatuszServer:
         """Driver-side supervision counters as they stand: attempts,
         restarts, classified failures (the supervisor already counts
         them on the driver registry; reading a counter that was never
-        written returns 0)."""
+        written returns 0) — plus the per-attempt world sizes the
+        launcher records, so an elastically shrunken gang is visible
+        in mission control (current attempt's world vs the previous
+        attempt's)."""
         from sparkdl_tpu import observe
+        from sparkdl_tpu.horovod.supervisor import attempt_world_sizes
 
         reg = observe.metrics()
+        worlds = attempt_world_sizes()
         return {
             "attempts_total": reg.counter("gang_attempts_total").value,
             "restarts_total": reg.counter("gang_restarts_total").value,
+            "world_size": worlds[-1] if worlds else self.num_workers,
+            "previous_world_size":
+                worlds[-2] if len(worlds) > 1 else None,
+            "world_sizes": worlds,
         }
 
     def _perf_window(self):

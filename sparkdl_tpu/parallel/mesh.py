@@ -63,6 +63,22 @@ def make_mesh(spec=None, devices=None):
     return Mesh(dev_array, AXES)
 
 
+def make_mesh_from_axes(axes, devices=None):
+    """Mesh from an axis-size dict (``{"data": 2, "model": 4}``) — the
+    restart context's ``target_axes`` contract: a relaunched worker
+    main rebuilds the supervisor-derived (shrunken or regrown) mesh
+    without guessing. Unknown axis names are an error; absent axes
+    default to 1."""
+    unknown = sorted(set(axes) - set(AXES))
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {unknown}; this runtime's axes are "
+            f"{list(AXES)}"
+        )
+    spec = MeshSpec(**{a: int(axes.get(a, 1)) for a in AXES})
+    return make_mesh(spec, devices=devices)
+
+
 def best_mesh(n_devices, *, model_parallel=1, seq_parallel=1, fsdp=False):
     """Heuristic spec: give `model`/`seq` what was asked, put the rest
     on `data` (or `fsdp`)."""

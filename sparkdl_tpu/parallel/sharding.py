@@ -82,6 +82,50 @@ def tp_param_info(params, shardings):
     return param_info_from(params, shardings)
 
 
+def named_sharding_for(mesh, spec_dims):
+    """Re-lay one recorded per-dim spec (tuples/lists of mesh axis
+    names, the sharding-tree-as-data serialization) onto ``mesh``:
+    axis names the target mesh doesn't have are dropped (that dim goes
+    replicated), everything else keeps its split. The inverse of the
+    ``ParamInfo.spec`` encoding, used by the resharded-restore path to
+    land checkpointed params directly on the surviving mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    have = {str(a) for a in mesh.axis_names}
+    entries = []
+    for dims in (spec_dims or ()):
+        kept = tuple(str(n) for n in (dims or ()) if str(n) in have)
+        if not kept:
+            entries.append(None)
+        elif len(kept) == 1:
+            entries.append(kept[0])
+        else:
+            entries.append(kept)
+    return NamedSharding(mesh, P(*entries))
+
+
+def full_host_value(x):
+    """Full (unsharded) host value of an array, whatever its layout:
+    fully-addressable arrays are materialized directly; arrays sharded
+    across processes are first replicated by an identity jit (an
+    all-gather on the wire — collective, so every participating
+    process must call this in the same order). The gang checkpoint
+    path uses it to persist cross-process GSPMD state from rank 0."""
+    import jax
+    import numpy as np
+
+    if not hasattr(x, "sharding") or getattr(
+            x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicate = jax.jit(
+        lambda v: v,
+        out_shardings=NamedSharding(x.sharding.mesh, P()),
+    )
+    return np.asarray(replicate(x))
+
+
 def sharding_tree_info(params, shardings):
     """The sharding tree **as data**: one
     :class:`~sparkdl_tpu.analysis.ParamInfo` per leaf carrying the full

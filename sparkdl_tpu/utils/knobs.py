@@ -258,6 +258,16 @@ def _build():
           "restart context: attempt number (worker-read)"),
         k("SPARKDL_TPU_RESUME_STEP", "int", None, "supervisor",
           "restart context: latest committed checkpoint step"),
+        k("SPARKDL_TPU_RESHARD_SOURCE_AXES", "str", None, "supervisor",
+          "restart context: JSON mesh axes the resume checkpoint was "
+          "laid out on (worker-read)"),
+        k("SPARKDL_TPU_RESHARD_TARGET_AXES", "str", None, "supervisor",
+          "restart context: JSON mesh axes shrink_mesh derived for "
+          "the elastic relaunch target np (worker-read)"),
+        k("SPARKDL_TPU_RESHARD_GROUPED", "int", "0", "supervisor",
+          "resharded-restore group size override: >0 places that many "
+          "params per group; 0 = auto (group only when the restore "
+          "high-water approaches the HBM budget)"),
 
         # -- static analysis pre-flight -----------------------------
         k("SPARKDL_TPU_PREFLIGHT_LINT", "bool", "0", "analysis",

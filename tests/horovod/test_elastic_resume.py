@@ -1,0 +1,498 @@
+"""Elastic resume for real (ISSUE 15): restore-time checkpoint
+resharding proven by chaos.
+
+PR 8 built the pre-flight (``reshard_plan``/``shrink_mesh`` refuse an
+infeasible ``SPARKDL_TPU_GANG_RELAUNCH_NP``); these tests prove the
+*restore* half end to end:
+
+1. every :meth:`TrainCheckpointer.save` persists a jax-free
+   sharding-tree sidecar, committed before the orbax step rename;
+2. ``restore(..., target_mesh=...)`` re-lays params onto whatever mesh
+   the surviving world built — bit-exact-modulo-resharding, within the
+   reshard plan's restore high-water accounting;
+3. a corrupt newest step falls back to the previous committed step
+   instead of burning the gang's retry budget;
+4. the chaos acceptance: kill a rank mid-training → the supervisor
+   relaunches at np-1 with the gang RESIZED and the restart context
+   carrying the recorded source axes + derived target axes → params
+   restore bit-exact onto the shrunken mesh → train → grow back to np
+   → final params match a never-killed np control run.
+
+Unit pieces ride tier-1; the gang proofs are gang+slow+chaos like the
+rest of the fault-tolerance suite.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from sparkdl import HorovodRunner
+from sparkdl_tpu.utils.checkpoint import (
+    SHARDING_TREE_SCHEMA,
+    TrainCheckpointer,
+    latest_complete_step,
+    load_sharding_tree,
+    sharding_sidecar_path,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# -- sidecar + resharded restore (single process, tier-1) -------------------
+
+
+def _mesh(axes, n=None):
+    import jax
+
+    from sparkdl_tpu.parallel.mesh import make_mesh_from_axes
+
+    devices = None if n is None else jax.devices()[:n]
+    return make_mesh_from_axes(axes, devices=devices)
+
+
+def _sharded_state(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w = jax.device_put(
+        np.arange(32, dtype=np.float32).reshape(8, 4),
+        NamedSharding(mesh, P("data", "model")),
+    )
+    b = jax.device_put(np.ones((6,), np.float32),
+                       NamedSharding(mesh, P()))
+    return {"w": w, "b": b}
+
+
+def test_save_writes_schema_versioned_sidecar(tmp_path):
+    mesh = _mesh({"data": 4, "model": 2})
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        assert ckpt.save(3, _sharded_state(mesh))
+    finally:
+        ckpt.close()
+    doc = load_sharding_tree(str(tmp_path), 3)
+    assert doc is not None and doc["schema"] == SHARDING_TREE_SCHEMA
+    assert doc["step"] == 3
+    assert doc["mesh_axes"]["data"] == 4
+    assert doc["mesh_axes"]["model"] == 2
+    by_path = {p["path"]: p for p in doc["params"]}
+    assert by_path["['w']"]["spec"] == [["data"], ["model"]]
+    assert by_path["['w']"]["shape"] == [8, 4]
+    assert by_path["['b']"]["spec"] == [[]]
+    # sidecar durable whenever the numeric step dir is: written
+    # BEFORE the orbax commit rename
+    assert latest_complete_step(str(tmp_path)) == 3
+    assert os.path.exists(sharding_sidecar_path(str(tmp_path), 3))
+
+
+def test_sidecar_pruned_with_retention(tmp_path):
+    mesh = _mesh({"data": 4, "model": 2})
+    ckpt = TrainCheckpointer(str(tmp_path), max_to_keep=2)
+    try:
+        for step in range(4):
+            ckpt.save(step, _sharded_state(mesh))
+    finally:
+        ckpt.close()
+    live = {
+        int(n[len("sharding_tree-"):-len(".json")])
+        for n in os.listdir(str(tmp_path))
+        if n.startswith("sharding_tree-")
+    }
+    # retention kept the last 2 steps; stale sidecars went with them
+    assert 3 in live and 0 not in live
+
+
+def test_restore_reshards_onto_smaller_mesh_bit_exact(tmp_path,
+                                                      monkeypatch):
+    import jax
+
+    from sparkdl_tpu import observe
+
+    # telemetry on: the reshard must land on the timeline AND in the
+    # gang_reshards_total{direction} counter
+    monkeypatch.setenv("SPARKDL_TPU_TELEMETRY_DIR",
+                       str(tmp_path / "telemetry"))
+    observe._reset_for_tests()
+    mesh = _mesh({"data": 4, "model": 2})
+    state = _sharded_state(mesh)
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, state)
+        target = _mesh({"data": 2, "model": 2}, n=4)
+        out = ckpt.restore(0, target_mesh=target)
+        assert np.array_equal(np.asarray(out["w"]),
+                              np.asarray(state["w"]))
+        assert np.array_equal(np.asarray(out["b"]),
+                              np.asarray(state["b"]))
+        # params landed DIRECTLY on the new mesh with their recorded
+        # split re-laid
+        assert out["w"].sharding.mesh.devices.size == 4
+        assert tuple(out["w"].sharding.spec) == ("data", "model")
+        stats = ckpt.last_reshard
+        assert stats["direction"] == "shrink"
+        assert stats["source_axes"]["data"] == 4
+        assert stats["target_axes"]["data"] == 2
+        assert (stats["high_water_accounted_bytes"]
+                <= stats["restore_high_water_bytes"])
+        assert observe.metrics().counter(
+            "gang_reshards_total", direction="shrink").value >= 1
+        events = observe.timeline().drain()
+        assert any(e.get("name") == "gang.reshard" for e in events)
+    finally:
+        ckpt.close()
+        observe._reset_for_tests()
+    del jax  # silence linters; jax import asserts the test rig mesh
+
+
+def test_grouped_restore_accounts_below_whole_tree_high_water(
+        tmp_path, monkeypatch):
+    mesh = _mesh({"data": 4, "model": 2})
+    state = _sharded_state(mesh)
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, state)
+    finally:
+        ckpt.close()
+    monkeypatch.setenv("SPARKDL_TPU_RESHARD_GROUPED", "1")
+    fresh = TrainCheckpointer(str(tmp_path))
+    try:
+        target = _mesh({"data": 2, "model": 2}, n=4)
+        out = fresh.restore(0, target_mesh=target)
+        assert np.array_equal(np.asarray(out["w"]),
+                              np.asarray(state["w"]))
+        stats = fresh.last_reshard
+        assert stats["mode"] == "grouped" and stats["groups"] == 2
+        # param-group-at-a-time: old+new shards of ONE group resident,
+        # strictly below the whole-tree worst case the plan bounds
+        assert (stats["high_water_accounted_bytes"]
+                < stats["restore_high_water_bytes"])
+    finally:
+        fresh.close()
+
+
+def test_direct_restore_uses_abstract_sharded_targets(tmp_path):
+    import jax
+
+    mesh = _mesh({"data": 4, "model": 2})
+    state = _sharded_state(mesh)
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, state)
+    finally:
+        ckpt.close()
+    fresh = TrainCheckpointer(str(tmp_path))
+    try:
+        target = {
+            "w": jax.ShapeDtypeStruct((8, 4), np.float32),
+            "b": jax.ShapeDtypeStruct((6,), np.float32),
+        }
+        out = fresh.restore(
+            0, target=target, target_mesh=_mesh({"data": 2, "model": 2},
+                                                n=4))
+        assert fresh.last_reshard["mode"] == "direct"
+        assert np.array_equal(np.asarray(out["w"]),
+                              np.asarray(state["w"]))
+    finally:
+        fresh.close()
+
+
+def test_infeasible_reshard_raises_typed_error(tmp_path):
+    from sparkdl_tpu.analysis.comms import ReshardPreflightError
+
+    mesh = _mesh({"data": 4, "model": 2})
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, _sharded_state(mesh))
+        # w is (8, 4): dim 1 cannot split 3 ways — the same typed
+        # refusal the supervisor pre-flight raises, at restore time
+        bad = _mesh({"data": 2, "model": 3}, n=6)
+        with pytest.raises(ReshardPreflightError):
+            ckpt.restore(0, target_mesh=bad)
+        # a deterministic refusal is NOT corruption: no fallback walk,
+        # no quarantine — the committed step must survive untouched
+        assert latest_complete_step(str(tmp_path)) == 0
+    finally:
+        ckpt.close()
+
+
+def test_legacy_checkpoint_without_sidecar_degrades(tmp_path):
+    mesh = _mesh({"data": 4, "model": 2})
+    state = _sharded_state(mesh)
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, state)
+        os.unlink(sharding_sidecar_path(str(tmp_path), 0))
+        out = ckpt.restore(0, target_mesh=_mesh({"data": 2, "model": 2},
+                                                n=4))
+        # pre-elastic checkpoint: restored, loudly, without resharding
+        assert np.array_equal(np.asarray(out["w"]),
+                              np.asarray(state["w"]))
+        assert ckpt.last_reshard is None
+    finally:
+        ckpt.close()
+
+
+# -- corrupt-step fallback --------------------------------------------------
+
+
+def test_corrupt_newest_step_falls_back_to_previous(tmp_path,
+                                                    monkeypatch):
+    from sparkdl_tpu import observe
+
+    monkeypatch.setenv("SPARKDL_TPU_TELEMETRY_DIR",
+                       str(tmp_path / "telemetry"))
+    observe._reset_for_tests()
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, {"w": np.zeros((4,), np.float32)})
+        ckpt.save(1, {"w": np.ones((4,), np.float32)})
+    finally:
+        ckpt.close()
+    # A torn write that still got a numeric dir name: the newest
+    # "committed" step is unreadable garbage.
+    (tmp_path / "2").mkdir()
+    fresh = TrainCheckpointer(str(tmp_path))
+    try:
+        assert fresh.latest_step() == 2
+        out = fresh.restore(
+            target={"w": np.zeros((4,), np.float32)})
+        assert np.asarray(out["w"]).tolist() == [1.0] * 4
+        # the caller's resume bookkeeping re-syncs from what actually
+        # loaded, not from what was asked for
+        assert fresh.last_restored_step == 1
+        assert observe.metrics().counter(
+            "checkpoint_corrupt_steps_total").value >= 1
+        # the torn dir was quarantined: the resume-point scan (and
+        # the next relaunch) steers to the good step, not the poison
+        assert latest_complete_step(str(tmp_path)) == 1
+    finally:
+        fresh.close()
+        observe._reset_for_tests()
+
+
+def test_corrupt_step_fallback_disabled_surfaces_error(tmp_path):
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(0, {"w": np.zeros((4,), np.float32)})
+    finally:
+        ckpt.close()
+    (tmp_path / "5").mkdir()
+    fresh = TrainCheckpointer(str(tmp_path))
+    try:
+        with pytest.raises(Exception):
+            fresh.restore(5, target={"w": np.zeros((4,), np.float32)},
+                          fallback=False)
+        # fallback off: the torn dir is surfaced, never quarantined
+        assert (tmp_path / "5").is_dir()
+    finally:
+        fresh.close()
+
+
+# -- restart context axes ---------------------------------------------------
+
+
+def test_restart_context_carries_reshard_axes(monkeypatch):
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.horovod.supervisor import (
+        RESHARD_SOURCE_AXES_ENV,
+        RESHARD_TARGET_AXES_ENV,
+    )
+
+    ctx = restart_context()
+    assert ctx.source_axes is None and ctx.target_axes is None
+    monkeypatch.setenv(RESHARD_SOURCE_AXES_ENV,
+                       json.dumps({"data": 2, "model": 1}))
+    monkeypatch.setenv(RESHARD_TARGET_AXES_ENV,
+                       json.dumps({"data": 1, "model": 1}))
+    ctx = restart_context()
+    assert ctx.source_axes == {"data": 2, "model": 1}
+    assert ctx.target_axes == {"data": 1, "model": 1}
+    monkeypatch.setenv(RESHARD_TARGET_AXES_ENV, "not json")
+    assert restart_context().target_axes is None
+
+
+def test_supervisor_ships_reshard_axes_from_sidecar(tmp_path,
+                                                    monkeypatch):
+    """With no registered sharding tree, the supervisor derives the
+    restart context's axes from the resume checkpoint's sidecar —
+    jax-free on the driver."""
+    from sparkdl_tpu.horovod.supervisor import (
+        GangFailure,
+        RetryPolicy,
+        supervise,
+    )
+
+    mesh = _mesh({"data": 2, "model": 2}, n=4)
+    ckpt = TrainCheckpointer(str(tmp_path))
+    try:
+        ckpt.save(7, _sharded_state(mesh))
+    finally:
+        ckpt.close()
+    monkeypatch.setenv("SPARKDL_TPU_GANG_RELAUNCH_NP", "2")
+    from sparkdl_tpu.analysis.comms import clear_gang_sharding
+
+    clear_gang_sharding()
+    seen = []
+
+    def launch(extra_env):
+        seen.append(dict(extra_env))
+        if len(seen) == 1:
+            raise GangFailure("preempted", kind="worker_death",
+                              exit_codes=[-signal.SIGKILL])
+        return "done"
+
+    policy = RetryPolicy(max_retries=2, backoff_base=0.0, jitter=0.0,
+                         resume_dir=str(tmp_path))
+    assert supervise(launch, policy, _sleep=lambda s: None) == "done"
+    env = seen[1]
+    assert env["SPARKDL_TPU_GANG_RELAUNCH_NP"] == "2"
+    src = json.loads(env["SPARKDL_TPU_RESHARD_SOURCE_AXES"])
+    tgt = json.loads(env["SPARKDL_TPU_RESHARD_TARGET_AXES"])
+    assert src["data"] == 2 and src["model"] == 2
+    # shrink_mesh preserves model, data absorbs: np=2 -> data=1
+    assert tgt == {"data": 1, "fsdp": 1, "seq": 1, "model": 2}
+
+
+# -- the chaos acceptance: kill -> shrink -> train -> grow ------------------
+
+
+def _elastic_train_main(ckpt_dir, total_steps):
+    """Deterministic GSPMD training loop whose state is sharded over
+    the gang mesh ({"data": world}) and checkpointed every step. The
+    update depends on the step only, so the trajectory is identical at
+    any world size — what makes bit-exact-modulo-resharding a
+    meaningful assertion. Resumable three ways: supervisor restart
+    context (with target axes), or a fresh run against an existing
+    checkpoint dir (the grow-back leg), or from scratch."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.parallel.mesh import make_mesh_from_axes
+    from sparkdl_tpu.parallel.sharding import full_host_value
+    from sparkdl_tpu.utils.chaos import chaos_step
+    from sparkdl_tpu.utils.checkpoint import (
+        TrainCheckpointer,
+        latest_complete_step,
+    )
+
+    hvd.init()
+    ctx = restart_context()
+    axes = dict(ctx.target_axes or {"data": hvd.size()})
+    mesh = make_mesh_from_axes(axes)
+    sharding = NamedSharding(mesh, P("data", None))
+    host = np.ones((8, 4), np.float32)
+    w = jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+    ckpt = TrainCheckpointer(ckpt_dir)
+    step_fn = jax.jit(lambda a, g: (a - 0.01 * g).astype(np.float32))
+    resume = ctx.resume_step
+    if resume is None:
+        resume = latest_complete_step(ckpt_dir)
+    start = 0
+    restored_w = None
+    reshard = None
+    if resume is not None:
+        w = ckpt.restore(resume, target_mesh=mesh)["w"]
+        reshard = dict(ckpt.last_reshard) if ckpt.last_reshard else None
+        restored_w = full_host_value(w).tolist()
+        start = resume + 1
+    history = {}
+    try:
+        for step in range(start, total_steps):
+            # step-dependent, rank-independent gradient: the allreduce
+            # proves gang liveness without making the math depend on np
+            g = hvd.allreduce(
+                np.full((8, 4), float(step + 1), np.float32),
+                op=hvd.Average)
+            w = step_fn(w, np.asarray(g))
+            ckpt.save(step, {"w": w})
+            ckpt.wait_until_finished()
+            hvd.barrier()   # rank 0's save durable before any death
+            history[str(step)] = full_host_value(w).tolist()
+            chaos_step(step)
+    finally:
+        ckpt.close()
+    return {
+        "w": full_host_value(w).tolist(),
+        "attempt": ctx.attempt,
+        "resume_step": ctx.resume_step,
+        "world": hvd.size(),
+        "axes": axes,
+        "restored_w": restored_w,
+        "reshard": reshard,
+        "history": history,
+    }
+
+
+@pytest.mark.gang
+@pytest.mark.slow
+def test_kill_shrink_train_grow_matches_control(monkeypatch, tmp_path):
+    """The ISSUE 15 acceptance: the full elastic round trip."""
+    steps, extra = 5, 3
+
+    # Never-killed np=2 control for the whole trajectory.
+    control = HorovodRunner(np=-2).run(
+        _elastic_train_main, ckpt_dir=str(tmp_path / "control"),
+        total_steps=steps + extra)
+    assert control["attempt"] == 0 and control["world"] == 2
+
+    # Leg 1: kill rank 1 at step 2 -> supervised relaunch at np=1.
+    monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_BASE", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_MAX", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_RESUME_DIR",
+                       str(tmp_path / "ck"))
+    monkeypatch.setenv("SPARKDL_TPU_GANG_RELAUNCH_NP", "1")
+    monkeypatch.setenv("SPARKDL_TPU_ABORT_GRACE", "5")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_KILL_RANK", "1")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_KILL_STEP", "2")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_ONCE_FILE",
+                       str(tmp_path / "one-kill"))
+
+    shrunken = HorovodRunner(np=-2).run(
+        _elastic_train_main, ckpt_dir=str(tmp_path / "ck"),
+        total_steps=steps)
+
+    assert (tmp_path / "one-kill").exists()   # the kill really fired
+    assert shrunken["attempt"] == 1           # exactly one relaunch
+    assert shrunken["resume_step"] == 2
+    assert shrunken["world"] == 1             # the gang actually shrank
+    assert shrunken["axes"]["data"] == 1      # supervisor-derived mesh
+    # params restored bit-exact-modulo-resharding vs the pre-kill
+    # checkpoint (the control's post-step-2 state)
+    assert shrunken["restored_w"] == control["history"]["2"]
+    reshard = shrunken["reshard"]
+    assert reshard is not None
+    assert reshard["direction"] == "shrink"
+    assert reshard["source_axes"]["data"] == 2
+    assert reshard["target_axes"]["data"] == 1
+    assert (reshard["high_water_accounted_bytes"]
+            <= reshard["restore_high_water_bytes"])
+    # the shrunken trajectory stays on the control's rails
+    assert shrunken["w"] == control["history"][str(steps - 1)]
+
+    # Leg 2: capacity came back — grow to np=2 against the same
+    # checkpoint dir (fresh run, no supervisor context: the main
+    # resumes from the latest committed step and reshards 1 -> 2).
+    for var in ("SPARKDL_TPU_GANG_RELAUNCH_NP",
+                "SPARKDL_TPU_CHAOS_KILL_RANK",
+                "SPARKDL_TPU_CHAOS_KILL_STEP",
+                "SPARKDL_TPU_CHAOS_ONCE_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    grown = HorovodRunner(np=-2).run(
+        _elastic_train_main, ckpt_dir=str(tmp_path / "ck"),
+        total_steps=steps + extra)
+    assert grown["world"] == 2
+    assert grown["reshard"] is not None
+    assert grown["reshard"]["direction"] == "grow"
+    assert grown["reshard"]["source_axes"]["data"] == 1
+    assert grown["reshard"]["target_axes"]["data"] == 2
+    # the regrown run restored the shrunken run's final step bit-exact
+    assert grown["restored_w"] == shrunken["w"]
+    # ... and the full round trip matches the never-killed control
+    assert grown["w"] == control["w"]

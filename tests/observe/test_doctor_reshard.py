@@ -1,0 +1,62 @@
+"""observe.doctor over an elastic-resume run dir (ISSUE 15
+satellite): the ``gang.reshard`` span a resharded restore leaves
+behind must render as a reshard section — old axes → new axes, bytes
+moved, accounted high water vs the plan's bound vs HBM — so a
+shrunken gang's topology transition is reproducible from artifacts
+alone."""
+
+import json
+import os
+
+from sparkdl_tpu.observe import doctor
+
+
+def _run_dir(tmp_path, events):
+    run_dir = str(tmp_path / "run-1-0")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "timeline.json"), "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return run_dir
+
+
+def test_doctor_renders_reshard_section(tmp_path):
+    reshard_args = {
+        "step": 2, "direction": "shrink", "mode": "grouped",
+        "params": 3, "groups": 3,
+        "source_axes": {"data": 2, "fsdp": 1, "seq": 1, "model": 1},
+        "target_axes": {"data": 1, "fsdp": 1, "seq": 1, "model": 1},
+        "bytes_moved": 4096,
+        "high_water_accounted_bytes": 6144,
+        "restore_high_water_bytes": 8192,
+        "hbm_bytes": 2 ** 34,
+    }
+    run_dir = _run_dir(tmp_path, [
+        {"name": "gang.resume", "cat": "supervisor", "ph": "i",
+         "ts": 1, "tid": 1,
+         "args": {"attempt": 1, "resume_step": 2,
+                  "target_axes": reshard_args["target_axes"]}},
+        {"name": "gang.reshard", "cat": "checkpoint", "ph": "X",
+         "ts": 2, "dur": 1000, "tid": 1, "args": reshard_args},
+    ])
+    diag = doctor.diagnose(run_dir)
+    assert diag is not None
+    (reshard,) = diag["reshards"]
+    assert reshard["direction"] == "shrink"
+    assert reshard["source_axes"]["data"] == 2
+    text = doctor.render_text(diag)
+    assert "reshard: shrink" in text
+    assert "data=2" in text and "data=1" in text
+    assert "4.0 KiB moved" in text
+    assert "high-water 6.0 KiB" in text
+    assert "plan bound 8.0 KiB" in text
+    assert "vs HBM 16.0 GiB" in text
+
+
+def test_doctor_without_reshard_has_no_section(tmp_path):
+    run_dir = _run_dir(tmp_path, [
+        {"name": "worker.start", "cat": "worker", "ph": "i",
+         "ts": 1, "tid": 1, "args": {"rank": 0}},
+    ])
+    diag = doctor.diagnose(run_dir)
+    assert diag["reshards"] == []
+    assert "reshard:" not in doctor.render_text(diag)

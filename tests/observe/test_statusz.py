@@ -151,6 +151,26 @@ def test_statusz_endpoint_ranks_perf_and_supervisor():
         server.close()
 
 
+def test_statusz_shows_attempt_world_sizes(monkeypatch):
+    """ISSUE 15 satellite: an elastically shrunken gang is visible in
+    mission control — the current attempt's world size next to the
+    previous attempt's."""
+    from sparkdl_tpu.horovod import supervisor
+
+    monkeypatch.setattr(supervisor, "_attempt_worlds", [])
+    supervisor.record_attempt_world(2)
+    supervisor.record_attempt_world(1)   # the np-1 relaunch
+    server = StatuszServer(GangTelemetry(), num_workers=2).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        sup = doc["supervisor"]
+        assert sup["world_size"] == 1
+        assert sup["previous_world_size"] == 2
+        assert sup["world_sizes"] == [2, 1]
+    finally:
+        server.close()
+
+
 def test_events_endpoint_streams_sse_tail():
     gt = GangTelemetry()
     gt.ingest(1, _payload(100, events=[
