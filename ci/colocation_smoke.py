@@ -1,0 +1,378 @@
+#!/usr/bin/env python
+"""CI colocation smoke (ISSUE 16): one pod, training AND serving, the
+chip-budget arbiter moving chips between them — FAIL the build unless
+the full yield/reclaim cycle closes with serving latency held:
+
+- a 1-replica :class:`FleetFrontend` (tiny engine-shaped stub with a
+  deliberate per-request delay) serves while a 2-rank training gang
+  runs in the same driver process;
+- request load makes the fleet's p99 TTFT blow the configured
+  ``server_ttft`` alert bound → the arbiter YIELDS a training chip:
+  the gang shrinks 2→1 through the elastic checkpoint-boundary path
+  and the fleet scales up to 2 replicas;
+- the load stops, the demand signal stays quiet for the clear window
+  → training RECLAIMS: the fleet scales back to 1 and the gang grows
+  1→2, finishing on the control trajectory;
+- every decision is visible in ``elastic.json``, on the timeline
+  (``elastic.*`` instants, ``gang.resize``), in the
+  ``gang_elastic_transitions_total{direction,reason}`` metric, and in
+  a mid-run ``/statusz`` scrape — and the client-side p99 request
+  latency stays under ``SPARKDL_TPU_COLOCATION_TTFT_P99_S`` (default
+  30 s) through the whole cycle.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/colocation_smoke.py``
+(defaults the dir to ``./colocation-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEADLINE_S = 420
+TOTAL_STEPS = 30
+STEP_S = 0.4
+STATUSZ_PORT = 18731
+ENGINE_DELAY_S = 0.25
+
+
+class _FakeCfg:
+    max_cache_len = 64
+
+
+class _SlowEngine:
+    """Engine-shaped stub (the test_fleet pattern) whose per-request
+    delay makes TTFT provably exceed the alert bound."""
+
+    def __init__(self):
+        self.cfg = _FakeCfg()
+        self.telemetry = None
+        self.finish_reasons = {}
+        self.logprobs = {}
+        self._queued = {}
+        self._next = 0
+
+    def _worst_case_tokens(self, prompt_len, max_new):
+        return prompt_len + max_new
+
+    def submit(self, tokens, max_new_tokens, stop=None):
+        rid = self._next
+        self._next += 1
+        self._queued[rid] = max_new_tokens
+        return rid
+
+    def run(self, progress=None, on_token=None):
+        import numpy as np
+
+        out = {}
+        for rid, n in self._queued.items():
+            if self.telemetry is not None:
+                self.telemetry.request_admitted(rid)
+            time.sleep(ENGINE_DELAY_S)
+            toks = np.arange(n, dtype=np.int32)
+            if on_token is not None:
+                for t in toks:
+                    on_token(rid, t)
+            out[rid] = toks
+            self.finish_reasons[rid] = "length"
+            self.logprobs[rid] = [0.0] * n
+        self._queued.clear()
+        return out
+
+    def abort_requests(self):
+        self._queued.clear()
+
+
+def _train_main(ckpt_dir, total_steps, step_s=0.0):
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.horovod import restart_context
+    from sparkdl_tpu.parallel.mesh import make_mesh_from_axes
+    from sparkdl_tpu.parallel.sharding import full_host_value
+    from sparkdl_tpu.utils.checkpoint import TrainCheckpointer
+
+    hvd.init()
+    ctx = restart_context()
+    axes = dict(ctx.target_axes or {"data": hvd.size()})
+    mesh = make_mesh_from_axes(axes)
+    host = np.ones((8, 4), np.float32)
+    w = jax.make_array_from_callback(
+        host.shape, NamedSharding(mesh, P("data", None)),
+        lambda idx: host[idx])
+    ckpt = TrainCheckpointer(ckpt_dir)
+    step_fn = jax.jit(lambda a, g: (a - 0.01 * g).astype(np.float32))
+    start = 0
+    if ctx.resume_step is not None:
+        w = ckpt.restore(ctx.resume_step, target_mesh=mesh)["w"]
+        start = ctx.resume_step + 1
+    try:
+        for step in range(start, total_steps):
+            g = hvd.allreduce(
+                np.full((8, 4), float(step + 1), np.float32),
+                op=hvd.Average)
+            w = step_fn(w, np.asarray(g))
+            ckpt.save(step, {"w": w})
+            ckpt.wait_until_finished()
+            hvd.barrier()
+            if step_s:
+                time.sleep(step_s)
+    finally:
+        ckpt.close()
+    return {
+        "w": full_host_value(w).tolist(),
+        "attempt": ctx.attempt,
+        "world": hvd.size(),
+        "axes": axes,
+    }
+
+
+def _expected(total_steps):
+    import numpy as np
+
+    w = np.ones((8, 4), np.float32)
+    for step in range(total_steps):
+        g = np.full((8, 4), float(step + 1), np.float32)
+        w = (w - 0.01 * g).astype(np.float32)
+    return w.tolist()
+
+
+def fail(msg):
+    print(f"COLOCATION SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _load_loop(fleet, latencies, errors, stop):
+    """Serving load: sequential tiny requests until the arbiter's
+    yield lands (the fleet reaches 2 replicas) or the smoke stops.
+    Records client-observed request latency — the SLO the cycle must
+    hold."""
+    url = f"http://{fleet.address[0]}:{fleet.address[1]}/generate"
+    while not stop.is_set() and fleet.replica_count() < 2:
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            url, data=json.dumps(
+                {"tokens": [1, 2], "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+            latencies.append(time.monotonic() - t0)
+        except Exception as e:
+            errors.append(str(e))
+        time.sleep(0.05)
+
+
+def _statusz_scraper(saw, stop):
+    """Mid-run /statusz scrape: the elastic section must be visible
+    WHILE the cycle runs, not just in the post-hoc artifacts."""
+    url = f"http://127.0.0.1:{STATUSZ_PORT}/statusz"
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                doc = json.loads(r.read())
+        except Exception:
+            time.sleep(0.3)
+            continue
+        el = doc.get("elastic")
+        if isinstance(el, dict):
+            saw["elastic"] = True
+            if el.get("arbiter"):
+                saw["arbiter"] = True
+            if el.get("yielded_chips"):
+                saw["yielded"] = True
+        sup = doc.get("supervisor") or {}
+        if sup.get("chip_hours"):
+            saw["chip_hours"] = True
+        time.sleep(0.3)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "colocation-artifacts"),
+    )
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    os.makedirs(out_dir, exist_ok=True)
+    ck = os.path.join(out_dir, "ck")
+    p99_bound = float(os.environ.get(
+        "SPARKDL_TPU_COLOCATION_TTFT_P99_S", "30"))
+    os.environ.update({
+        "SPARKDL_TPU_GANG_MAX_RETRIES": "2",
+        "SPARKDL_TPU_GANG_BACKOFF_BASE": "0.2",
+        "SPARKDL_TPU_GANG_BACKOFF_MAX": "0.5",
+        "SPARKDL_TPU_GANG_RESUME_DIR": ck,
+        "SPARKDL_TPU_ABORT_GRACE": "10",
+        "SPARKDL_TPU_STATUSZ_PORT": str(STATUSZ_PORT),
+        # the demand signal: the fleet's p99 TTFT against a bound the
+        # slow engine is built to blow
+        "SPARKDL_TPU_ALERTS": "1",
+        "SPARKDL_TPU_ALERT_CHECK_S": "0.2",
+        "SPARKDL_TPU_ALERT_MIN_STEPS": "3",
+        "SPARKDL_TPU_ALERT_TTFT_P99_S": "0.05",
+        # the arbiter: capacity pinned at 2 chips (env probe) so the
+        # only elastic motion is the yield/reclaim cycle under test
+        "SPARKDL_TPU_ELASTIC": "1",
+        "SPARKDL_TPU_ELASTIC_CAPACITY": "2",
+        "SPARKDL_TPU_ELASTIC_CHECK_S": "0.1",
+        "SPARKDL_TPU_ELASTIC_ARBITER": "1",
+        "SPARKDL_TPU_ELASTIC_ARBITER_CHIPS": "1",
+        "SPARKDL_TPU_ELASTIC_ARBITER_CLEAR_S": "2.5",
+        "SPARKDL_TPU_ELASTIC_MIN_NP": "1",
+        "SPARKDL_TPU_ELASTIC_CKPT_WAIT_S": "60",
+    })
+
+    from sparkdl import HorovodRunner
+    from sparkdl_tpu.models.fleet import FleetFrontend
+
+    fleet = FleetFrontend(_SlowEngine, replicas=1, max_queue=64,
+                          hang_seconds=120, poll_seconds=0.1).start()
+    latencies, errors = [], []
+    stop = threading.Event()
+    saw = {}
+    loader = threading.Thread(
+        target=_load_loop, args=(fleet, latencies, errors, stop),
+        daemon=True)
+    scraper = threading.Thread(
+        target=_statusz_scraper, args=(saw, stop), daemon=True)
+    loader.start()
+    scraper.start()
+
+    t0 = time.monotonic()
+    try:
+        result = HorovodRunner(np=-2).run(
+            _train_main, ckpt_dir=ck, total_steps=TOTAL_STEPS,
+            step_s=STEP_S)
+    finally:
+        stop.set()
+    elapsed = time.monotonic() - t0
+    loader.join(timeout=10)
+    scraper.join(timeout=10)
+    print(f"gang result: attempt={result['attempt']} "
+          f"world={result['world']} ({elapsed:.1f}s); "
+          f"{len(latencies)} serving requests, {len(errors)} errors")
+    if elapsed > DEADLINE_S:
+        fail(f"yield/reclaim cycle took {elapsed:.0f}s "
+             f"(deadline {DEADLINE_S}s)")
+
+    # training came back: full width, control trajectory
+    if result["world"] != 2:
+        fail(f"training did not reclaim its chips "
+             f"(final world={result['world']})")
+    if result["attempt"] != 2:
+        fail(f"expected two elastic relaunches (yield, reclaim), got "
+             f"attempt {result['attempt']}")
+    if result["w"] != _expected(TOTAL_STEPS):
+        fail("final params differ from the uninterrupted trajectory")
+
+    # the fleet scaled up for the yield and back down on the reclaim
+    deadline = time.monotonic() + 10
+    while fleet.replica_count() != 1 and time.monotonic() < deadline:
+        time.sleep(0.2)
+    replicas = fleet.replica_count()
+    fleet.close()
+    if replicas != 1:
+        fail(f"fleet did not scale back to 1 replica after the "
+             f"reclaim (replicas={replicas})")
+
+    # serving held its SLO through the cycle
+    if not latencies:
+        fail("no serving request completed during the cycle")
+    if errors:
+        fail(f"{len(errors)} serving request(s) failed during the "
+             f"cycle: {errors[:3]}")
+    lat = sorted(latencies)
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    print(f"serving: {len(lat)} requests, p99 latency {p99:.3f}s "
+          f"(bound {p99_bound:g}s)")
+    if p99 > p99_bound:
+        fail(f"serving p99 {p99:.3f}s blew the {p99_bound:g}s bound")
+
+    # mid-run visibility: /statusz showed the elastic section live
+    if not saw.get("elastic"):
+        fail("the mid-run /statusz scrape never showed the elastic "
+             "section")
+    if not saw.get("arbiter"):
+        fail("/statusz elastic section never reported the arbiter on")
+
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected one run dir under {out_dir}, found {run_dirs}")
+    run = run_dirs[0]
+
+    # decisions in the artifacts: elastic.json, timeline, metrics
+    try:
+        with open(os.path.join(run, "elastic.json")) as f:
+            elastic = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"elastic.json missing or malformed: {e}")
+    decisions = elastic.get("decisions") or []
+    outcomes = {(d.get("direction"), d.get("outcome"))
+                for d in decisions}
+    if ("yield", "resize") not in outcomes:
+        fail(f"elastic.json records no emitted yield "
+             f"(decisions: {decisions})")
+    if ("reclaim", "resize") not in outcomes:
+        fail(f"elastic.json records no emitted reclaim "
+             f"(decisions: {decisions})")
+
+    try:
+        with open(os.path.join(run, "metrics.prom")) as f:
+            prom = f.read()
+    except OSError as e:
+        fail(f"metrics.prom missing: {e}")
+    trans = [ln for ln in prom.splitlines()
+             if ln.startswith("gang_elastic_transitions_total")]
+    for direction in ("yield", "reclaim"):
+        if not any(f'direction="{direction}"' in ln for ln in trans):
+            fail(f"no {direction} transition in the metrics "
+                 f"(have {trans})")
+
+    try:
+        with open(os.path.join(run, "timeline.json")) as f:
+            events = [e for e in json.load(f)["traceEvents"]
+                      if e.get("ph") != "M"]
+    except (OSError, ValueError, KeyError) as e:
+        fail(f"timeline.json missing or malformed: {e}")
+    names = {e.get("name") for e in events}
+    for required in ("gang.resize", "elastic.decision",
+                     "elastic.transition", "elastic.fleet_scale",
+                     "alert.server_ttft"):
+        if required not in names:
+            fail(f"timeline missing {required!r} "
+                 f"(have {sorted(names)})")
+
+    # observe.doctor renders the decision log from artifacts alone
+    doctor_env = dict(os.environ)
+    doctor_env["PYTHONPATH"] = (
+        REPO + os.pathsep + doctor_env.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run],
+        capture_output=True, text=True, timeout=120, env=doctor_env,
+    )
+    if r.returncode != 0:
+        fail(f"doctor exit {r.returncode}; stderr: {r.stderr[-400:]}")
+    if "elastic:" not in r.stdout or "[yield]" not in r.stdout:
+        fail(f"doctor did not render the yield decision:\n"
+             f"{r.stdout[-800:]}")
+    with open(os.path.join(run, "doctor.txt"), "w") as f:
+        f.write(r.stdout)
+    print(r.stdout)
+    print("COLOCATION SMOKE PASSED: serving alert -> training yield "
+          "-> fleet scale-up -> quiet -> reclaim -> full-width "
+          "finish, SLO held, decisions in the artifacts")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,23 @@
 #!/usr/bin/env python
-"""CI elastic-resume smoke (ISSUE 15): chaos-kill a rank in a 2-rank
-gang whose train state is sharded over the gang mesh, and FAIL the
-build unless the whole elastic loop closes: the supervisor relaunches
-at np=1 with the gang actually resized, the restart context carries
-the recorded source axes + the shrink_mesh-derived target axes, the
-checkpoint restores bit-exact-modulo-resharding onto the shrunken
-mesh within the reshard plan's high-water accounting, training
-completes on the control run's exact trajectory,
-``gang_reshards_total`` lands in the run dir's metrics, and
-``observe.doctor`` renders the reshard section from the artifacts
-alone. The run dir is uploaded by the workflow.
+"""CI elastic-resume smoke (ISSUE 15 + 16): chaos-kill a rank in a
+2-rank gang whose train state is sharded over the gang mesh, and FAIL
+the build unless the whole AUTONOMOUS elastic loop closes — with no
+operator step (no ``SPARKDL_TPU_GANG_RELAUNCH_NP``, no fresh run):
+
+- the capacity probe (file mode) says the pod only offers 1 chip, so
+  the supervisor relaunches the killed gang at np=1 with the gang
+  actually resized and the checkpoint restored bit-exact-modulo-
+  resharding onto the shrunken mesh;
+- when the harness returns the chip (flips the capacity file to 2
+  after the shrunken gang commits a step), the elastic controller
+  debounces the surplus, consults the ledger, plans a grow at the
+  next checkpoint boundary, and recycles the gang back to np=2
+  through the same reshard/restore path;
+- training completes ON THE CONTROL TRAJECTORY (the never-killed
+  arithmetic), ``gang_elastic_transitions_total`` lands in the run
+  dir metrics, the ``elastic.*`` decisions land on the timeline and
+  in ``elastic.json``, and ``observe.doctor`` renders both the
+  reshard and the elastic decision log from the artifacts alone.
 
 Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/elastic_smoke.py``
 (defaults the dir to ``./elastic-artifacts``). Runs outside the
@@ -21,6 +29,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 # Runnable as `python ci/elastic_smoke.py` from a checkout: the script
@@ -28,12 +37,13 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-DEADLINE_S = 300
-TOTAL_STEPS = 5
+DEADLINE_S = 420
+TOTAL_STEPS = 16
 KILL_STEP = 2
+STEP_S = 0.45      # per-step dwell so the 0.1s-cadence watcher can act
 
 
-def _elastic_main(ckpt_dir, total_steps):
+def _elastic_main(ckpt_dir, total_steps, step_s=0.0):
     import numpy as np
 
     import jax
@@ -74,6 +84,8 @@ def _elastic_main(ckpt_dir, total_steps):
             ckpt.wait_until_finished()
             hvd.barrier()
             chaos_step(step)
+            if step_s:
+                time.sleep(step_s)
     finally:
         ckpt.close()
     return {
@@ -107,62 +119,110 @@ def fail(msg):
     sys.exit(1)
 
 
+def _capacity_returner(cap_file, ckpt_dir, after_step):
+    """The chaos harness's 'chips came back' lever: once the SHRUNKEN
+    gang has committed a checkpoint (proof it resumed and progressed),
+    flip the capacity file to 2 — the controller must notice, debounce,
+    and grow back with no operator involvement."""
+    from sparkdl_tpu.utils.checkpoint import latest_complete_step
+
+    deadline = time.monotonic() + DEADLINE_S
+    while time.monotonic() < deadline:
+        try:
+            step = latest_complete_step(ckpt_dir)
+        except Exception:
+            step = None
+        if step is not None and step >= after_step:
+            with open(cap_file, "w") as f:
+                f.write("2")
+            print(f"capacity returned: wrote 2 chips after step {step} "
+                  "committed")
+            return
+        time.sleep(0.1)
+
+
 def main():
     out_dir = os.environ.setdefault(
         "SPARKDL_TPU_TELEMETRY_DIR",
         os.path.join(os.getcwd(), "elastic-artifacts"),
     )
     os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    os.makedirs(out_dir, exist_ok=True)
     ck = os.path.join(out_dir, "ck")
+    cap_file = os.path.join(out_dir, "capacity")
+    with open(cap_file, "w") as f:
+        f.write("1")   # the pod starts the run one chip short
+    # AUTONOMY: no SPARKDL_TPU_GANG_RELAUNCH_NP anywhere — the shrink
+    # comes from the capacity clamp, the grow from the controller.
+    assert "SPARKDL_TPU_GANG_RELAUNCH_NP" not in os.environ
     os.environ.update({
         "SPARKDL_TPU_GANG_MAX_RETRIES": "2",
         "SPARKDL_TPU_GANG_BACKOFF_BASE": "0.2",
         "SPARKDL_TPU_GANG_BACKOFF_MAX": "0.5",
         "SPARKDL_TPU_GANG_RESUME_DIR": ck,
-        "SPARKDL_TPU_GANG_RELAUNCH_NP": "1",
         "SPARKDL_TPU_ABORT_GRACE": "10",
         "SPARKDL_TPU_CHAOS_KILL_RANK": "1",
         "SPARKDL_TPU_CHAOS_KILL_STEP": str(KILL_STEP),
         "SPARKDL_TPU_CHAOS_ONCE_FILE": os.path.join(
             out_dir, "one-kill"),
+        # fast worker flush: the elastic resize KILLS the shrunken
+        # attempt moments after its restore — the shrink-leg
+        # gang.reshard span must have shipped to the driver by then
+        "SPARKDL_TPU_TELEMETRY_FLUSH_S": "0.1",
+        "SPARKDL_TPU_ELASTIC": "1",
+        "SPARKDL_TPU_ELASTIC_PROBE": "file",
+        "SPARKDL_TPU_ELASTIC_CAPACITY_FILE": cap_file,
+        "SPARKDL_TPU_ELASTIC_CHECK_S": "0.1",
+        "SPARKDL_TPU_ELASTIC_DEBOUNCE_S": "0.4",
+        "SPARKDL_TPU_ELASTIC_CKPT_WAIT_S": "60",
+        # an absent ledger: nothing provable, grow to the surplus
+        "SPARKDL_TPU_PERF_HISTORY": os.path.join(
+            out_dir, "history.jsonl"),
     })
 
     from sparkdl import HorovodRunner
 
+    returner = threading.Thread(
+        target=_capacity_returner,
+        args=(cap_file, ck, KILL_STEP + 1), daemon=True)
+    returner.start()
+
     t0 = time.monotonic()
     result = HorovodRunner(np=-2).run(
-        _elastic_main, ckpt_dir=ck, total_steps=TOTAL_STEPS)
+        _elastic_main, ckpt_dir=ck, total_steps=TOTAL_STEPS,
+        step_s=STEP_S)
     elapsed = time.monotonic() - t0
     print(f"gang result: attempt={result['attempt']} "
           f"world={result['world']} resume={result['resume_step']} "
           f"({elapsed:.1f}s)")
     if elapsed > DEADLINE_S:
-        fail(f"kill + shrink + resume took {elapsed:.0f}s "
+        fail(f"kill + shrink + autonomous grow took {elapsed:.0f}s "
              f"(deadline {DEADLINE_S}s)")
-    if result["attempt"] != 1:
-        fail(f"expected exactly one supervised relaunch, got "
-             f"attempt {result['attempt']}")
-    if result["world"] != 1:
-        fail(f"relaunched gang was not resized to np=1 "
+    if result["attempt"] != 2:
+        fail(f"expected two supervised relaunches (shrink, then the "
+             f"autonomous grow), got attempt {result['attempt']}")
+    if result["world"] != 2:
+        fail(f"final gang was not grown back to np=2 "
              f"(world={result['world']})")
-    if result["axes"].get("data") != 1:
-        fail(f"worker did not rebuild the shrunken mesh from the "
+    if result["axes"].get("data") != 2:
+        fail(f"worker did not rebuild the regrown mesh from the "
              f"restart context (axes={result['axes']})")
 
     expected = _expected(TOTAL_STEPS)
-    if result["resume_step"] != KILL_STEP:
-        fail(f"expected resume from step {KILL_STEP}, got "
-             f"{result['resume_step']}")
-    # bit-exact-modulo-resharding: the restored params equal the
-    # pre-kill trajectory, and the finished run stays on its rails
-    if result["restored_w"] != expected[KILL_STEP]:
-        fail("restored params differ from the pre-kill checkpoint "
-             "(not bit-exact-modulo-resharding)")
+    resume = result["resume_step"]
+    if resume is None or resume <= KILL_STEP:
+        fail(f"final attempt resumed from {resume} — the grow did not "
+             f"resume past the shrunken gang's progress")
+    # bit-exact-modulo-resharding: the grow restored the shrunken
+    # gang's exact params, and the finished run stays on its rails
+    if result["restored_w"] != expected[resume]:
+        fail("params restored by the grow differ from the shrunken "
+             "gang's checkpoint (not bit-exact-modulo-resharding)")
     if result["w"] != expected[TOTAL_STEPS - 1]:
         fail("final params differ from the uninterrupted trajectory")
     reshard = result["reshard"]
-    if not reshard or reshard.get("direction") != "shrink":
-        fail(f"no shrink reshard recorded in the restore "
+    if not reshard or reshard.get("direction") != "grow":
+        fail(f"the final restore did not record a grow reshard "
              f"(got {reshard})")
     if (reshard["high_water_accounted_bytes"]
             > reshard["restore_high_water_bytes"]):
@@ -177,7 +237,7 @@ def main():
         fail(f"expected one run dir under {out_dir}, found {run_dirs}")
     run = run_dirs[0]
 
-    # the reshard landed in the merged gang metrics
+    # both transitions landed in the merged gang metrics
     try:
         with open(os.path.join(run, "metrics.prom")) as f:
             prom = f.read()
@@ -185,6 +245,12 @@ def main():
         fail(f"metrics.prom missing: {e}")
     if "gang_reshards_total" not in prom:
         fail("gang_reshards_total missing from the run dir metrics")
+    trans = [ln for ln in prom.splitlines()
+             if ln.startswith("gang_elastic_transitions_total")]
+    if not any('direction="shrink"' in ln for ln in trans):
+        fail(f"no shrink transition in the metrics (have {trans})")
+    if not any('direction="grow"' in ln for ln in trans):
+        fail(f"no grow transition in the metrics (have {trans})")
 
     # ... and on the merged timeline
     try:
@@ -194,12 +260,26 @@ def main():
     except (OSError, ValueError, KeyError) as e:
         fail(f"timeline.json missing or malformed: {e}")
     names = {e.get("name") for e in events}
-    for required in ("gang.reshard", "gang.resume"):
+    for required in ("gang.reshard", "gang.resume", "gang.resize",
+                     "elastic.planned", "elastic.decision",
+                     "elastic.transition"):
         if required not in names:
             fail(f"timeline missing {required!r} "
                  f"(have {sorted(names)})")
 
-    # observe.doctor renders the reshard section from artifacts alone
+    # the decision log is an artifact of its own
+    try:
+        with open(os.path.join(run, "elastic.json")) as f:
+            elastic = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"elastic.json missing or malformed: {e}")
+    decisions = elastic.get("decisions") or []
+    if not any(d.get("direction") == "grow"
+               and d.get("outcome") == "resize" for d in decisions):
+        fail(f"elastic.json records no emitted grow decision "
+             f"(decisions: {decisions})")
+
+    # observe.doctor renders both sections from artifacts alone
     doctor_env = dict(os.environ)
     doctor_env["PYTHONPATH"] = (
         REPO + os.pathsep + doctor_env.get("PYTHONPATH", ""))
@@ -211,13 +291,20 @@ def main():
         fail(f"doctor exit {r.returncode} (expected 0, no hang); "
              f"stderr: {r.stderr[-400:]}")
     if "reshard: shrink" not in r.stdout:
-        fail(f"doctor did not render the reshard section:\n"
+        fail(f"doctor did not render the shrink reshard:\n"
+             f"{r.stdout[-800:]}")
+    if "reshard: grow" not in r.stdout:
+        fail(f"doctor did not render the grow reshard:\n"
+             f"{r.stdout[-800:]}")
+    if "elastic:" not in r.stdout:
+        fail(f"doctor did not render the elastic decision log:\n"
              f"{r.stdout[-800:]}")
     with open(os.path.join(run, "doctor.txt"), "w") as f:
         f.write(r.stdout)
     print(r.stdout)
-    print("ELASTIC SMOKE PASSED: kill -> shrink -> resharded resume "
-          "-> bit-exact finish, proven in the artifacts")
+    print("ELASTIC SMOKE PASSED: kill -> shrink -> autonomous grow -> "
+          "bit-exact finish, proven in the artifacts with no operator "
+          "step")
 
 
 if __name__ == "__main__":

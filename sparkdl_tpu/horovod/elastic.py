@@ -1,0 +1,736 @@
+"""Autonomous elasticity: capacity-watching grow-back and train/serve
+chip yield/reclaim on one pod (ISSUE 16).
+
+PR 15 proved the *mechanism* — kill → relaunch at a smaller np →
+resharded restore — but the grow leg stayed an operator action
+(export ``SPARKDL_TPU_GANG_RELAUNCH_NP``, start a fresh run). This
+module closes the loop so the runner, not the user, owns the cluster
+lifecycle:
+
+1. **Capacity watcher** (:func:`probe_capacity`): a pluggable probe of
+   how many chips the pod can offer right now — an env override for
+   tests and chaos (``SPARKDL_TPU_ELASTIC_CAPACITY``), a re-read-every-
+   poll file override (``..._CAPACITY_FILE`` — chaos flips it mid-run),
+   the ``/dev/accel*`` device count on TPU hosts, or the launcher's
+   local slot table. ``auto`` picks the first configured source; a
+   configured-but-unreadable override reports *unknown* rather than
+   falling through to a fantasy number.
+2. **Debounced grow-back**: a capacity surplus must hold for
+   ``SPARKDL_TPU_ELASTIC_DEBOUNCE_S`` before the controller even
+   considers growing — a flapping probe (chips blinking in and out
+   during a pod repair) must never thrash the gang shrink↔grow.
+3. **Ledger-driven np selection** (:func:`choose_np`): the target np
+   comes from ``history.jsonl`` throughput-per-chip medians (the same
+   ``observe.compare`` median discipline the perf gate uses), so the
+   gang never grows into a configuration the ledger proves slower per
+   chip. An unprofitable or infeasible grow raises the typed
+   :class:`ElasticGrowRefused` — the same refuse-don't-crash posture as
+   the reshard pre-flight.
+4. **Checkpoint-boundary resize**: a planned resize is emitted only
+   after the newest committed :class:`TrainCheckpointer` step advances
+   past the decision point (bounded by ``..._CKPT_WAIT_S``), so the
+   relaunch resumes from a step the resized gang has actually
+   persisted. The relaunch itself rides the proven PR 15 path —
+   reshard pre-flight, source/target axes in the restart context,
+   resharded restore, warm compile cache.
+5. **Chip-budget arbiter** (``SPARKDL_TPU_ELASTIC_ARBITER``): when the
+   alert engine's serving-pressure rules (``queue_depth_growth``, the
+   ``server_ttft`` p99 rule) fire on a colocated fleet, training
+   *yields* chips — the gang shrinks through the same elastic path and
+   the fleet scales up — and *reclaims* them when the demand signal
+   stays quiet for ``..._ARBITER_CLEAR_S``. Every grow/yield/reclaim
+   decision lands as a typed timeline instant, a
+   ``gang_elastic_transitions_total{direction,reason}`` counter, and a
+   line in the run dir's ``elastic.json`` decision log.
+
+Zero-overhead contract: nothing here runs unless ``SPARKDL_TPU_ELASTIC``
+is truthy — :func:`maybe_make_controller` returns None and the
+launcher's monitor loop pays one ``is not None`` test per tick.
+"""
+
+import glob
+import logging
+import os
+import threading
+import time
+
+from sparkdl_tpu import observe
+
+logger = logging.getLogger("HorovodRunner")
+
+ELASTIC_ENV = "SPARKDL_TPU_ELASTIC"
+PROBE_ENV = "SPARKDL_TPU_ELASTIC_PROBE"
+CAPACITY_ENV = "SPARKDL_TPU_ELASTIC_CAPACITY"
+CAPACITY_FILE_ENV = "SPARKDL_TPU_ELASTIC_CAPACITY_FILE"
+CHECK_S_ENV = "SPARKDL_TPU_ELASTIC_CHECK_S"
+DEBOUNCE_S_ENV = "SPARKDL_TPU_ELASTIC_DEBOUNCE_S"
+MARGIN_ENV = "SPARKDL_TPU_ELASTIC_MARGIN"
+CKPT_WAIT_S_ENV = "SPARKDL_TPU_ELASTIC_CKPT_WAIT_S"
+MAX_NP_ENV = "SPARKDL_TPU_ELASTIC_MAX_NP"
+MIN_NP_ENV = "SPARKDL_TPU_ELASTIC_MIN_NP"
+ARBITER_ENV = "SPARKDL_TPU_ELASTIC_ARBITER"
+ARBITER_RULES_ENV = "SPARKDL_TPU_ELASTIC_ARBITER_RULES"
+ARBITER_CHIPS_ENV = "SPARKDL_TPU_ELASTIC_ARBITER_CHIPS"
+ARBITER_CLEAR_S_ENV = "SPARKDL_TPU_ELASTIC_ARBITER_CLEAR_S"
+# Same literal as supervisor.RESUME_DIR_ENV (kept as a plain string so
+# import order between the two modules stays free).
+RESUME_DIR_ENV = "SPARKDL_TPU_GANG_RESUME_DIR"
+
+DEVICE_GLOB = "/dev/accel*"
+ELASTIC_SCHEMA = "sparkdl_tpu.horovod.elastic/1"
+
+# Ledger metric names accepted as throughput (higher = better), in
+# preference order, then step-time names inverted to a rate.
+_RATE_METRICS = ("steps_per_s", "examples_per_s", "tokens_per_s",
+                 "throughput")
+_STEP_TIME_METRICS = ("step_time_s", "train_step_seconds_mean")
+# Top-level ledger-record keys naming the world size the record was
+# measured at (history_record(..., extra={"np": N}) merges top-level).
+_NP_KEYS = ("np", "world", "world_size", "num_workers")
+
+
+def _truthy(raw):
+    return (raw or "").strip().lower() not in ("", "0", "false", "off")
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    if not n:
+        return None
+    mid = n // 2
+    if n % 2:
+        return xs[mid]
+    return 0.5 * (xs[mid - 1] + xs[mid])
+
+
+def probe_capacity(env=None):
+    """How many chips the pod can offer right now, or None (unknown).
+
+    Probe order under ``SPARKDL_TPU_ELASTIC_PROBE=auto`` (default):
+    the ``..._CAPACITY`` env int if set, else the ``..._CAPACITY_FILE``
+    contents if a path is configured (re-read every call — chaos and
+    tests flip it mid-run), else the ``/dev/accel*`` device count when
+    any exist, else the launcher's local slot table. A configured
+    override that fails to parse reports None — *unknown*, never a
+    fallthrough to a different source's fantasy number.
+    """
+    env = os.environ if env is None else env
+    mode = (env.get(PROBE_ENV) or "auto").strip().lower()
+
+    if mode in ("env", "auto"):
+        raw = env.get(CAPACITY_ENV)
+        if raw is not None and raw.strip():
+            try:
+                return int(raw)
+            except ValueError:
+                logger.warning("ignoring unparsable %s=%r",
+                               CAPACITY_ENV, raw)
+                return None
+        if mode == "env":
+            return None
+
+    if mode in ("file", "auto"):
+        path = env.get(CAPACITY_FILE_ENV)
+        if path:
+            try:
+                with open(path) as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                return None
+        if mode == "file":
+            return None
+
+    if mode in ("devices", "auto"):
+        n = len(glob.glob(DEVICE_GLOB))
+        if n or mode == "devices":
+            return n or None
+
+    if mode in ("slots", "auto"):
+        try:
+            from sparkdl_tpu.horovod.launcher import available_slots
+
+            return available_slots()
+        except Exception:
+            return None
+
+    return None
+
+
+class ElasticGrowRefused(RuntimeError):
+    """A grow was refused — infeasible (``reason="no_checkpoint"``: no
+    committed step to resume the resized gang from) or unprofitable
+    (``reason="unprofitable"``: the ledger's throughput-per-chip
+    medians prove every measured candidate slower per chip than where
+    the gang already is). Carries ``findings`` naming each rejected
+    candidate — the same typed-refusal posture as
+    :class:`~sparkdl_tpu.analysis.comms.ReshardPreflightError`."""
+
+    def __init__(self, message, *, findings=(), reason="unprofitable"):
+        super().__init__(message)
+        self.findings = list(findings)
+        self.reason = reason
+
+
+def _per_chip_throughput(history):
+    """{np: median throughput-per-chip} from ledger records that carry
+    a world size and a throughput (or invertible step-time) metric."""
+    by_np = {}
+    for entry in history or ():
+        if not isinstance(entry, dict):
+            continue
+        np_v = None
+        for key in _NP_KEYS:
+            v = entry.get(key)
+            if isinstance(v, (int, float)) and int(v) >= 1:
+                np_v = int(v)
+                break
+        if np_v is None:
+            continue
+        metrics = entry.get("metrics")
+        if not isinstance(metrics, dict):
+            continue
+        val = None
+        for name in _RATE_METRICS:
+            val = _metric_value(metrics.get(name))
+            if val is not None:
+                break
+        if val is None:
+            for name in _STEP_TIME_METRICS:
+                t = _metric_value(metrics.get(name))
+                if t is not None and t > 0:
+                    val = 1.0 / t
+                    break
+        if val is None or val <= 0:
+            continue
+        by_np.setdefault(np_v, []).append(val / np_v)
+    return {n: _median(vals) for n, vals in by_np.items()}
+
+
+def _metric_value(m):
+    """Median-over-samples when the record carries them (>=3), else the
+    point value — observe.compare's _effective_value discipline."""
+    if not isinstance(m, dict):
+        return None
+    try:
+        from sparkdl_tpu.observe.compare import _effective_value
+
+        v, _src = _effective_value(m)
+    except Exception:
+        v = m.get("value")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def choose_np(current_np, available_np, history=None, *, margin=None,
+              max_np=None):
+    """Target np for a grow from ``current_np`` given ``available_np``
+    chips: the largest candidate the ledger does not prove slower per
+    chip. Returns ``current_np`` when there is no surplus ("stay").
+    Raises :class:`ElasticGrowRefused` when every measured candidate's
+    throughput-per-chip median falls below ``margin`` x the current
+    np's — growing into a provably worse configuration is the one
+    move this policy exists to forbid. Candidates the ledger has never
+    measured are allowed (nothing provable — the preflight posture).
+    """
+    current_np = int(current_np)
+    cap = int(available_np)
+    if max_np:
+        cap = min(cap, int(max_np))
+    if cap <= current_np:
+        return current_np
+    if margin is None:
+        margin = float(os.environ.get(MARGIN_ENV) or "0.8")
+    if history is None:
+        from sparkdl_tpu.observe.perf import read_history
+
+        history = read_history()
+    per_chip = _per_chip_throughput(history)
+    cur = per_chip.get(current_np)
+    if cur is None or cur <= 0:
+        # No ledger evidence about where we are now: nothing provable,
+        # grow to the full surplus.
+        return cap
+    findings = []
+    for target in range(cap, current_np, -1):
+        pc = per_chip.get(target)
+        if pc is None:
+            return target
+        if pc >= margin * cur:
+            return target
+        findings.append(
+            f"np={target}: ledger median {pc:.4g}/chip < "
+            f"{margin:.2f} x np={current_np}'s {cur:.4g}/chip")
+    raise ElasticGrowRefused(
+        f"grow from np={current_np} toward np={cap} refused: every "
+        "measured candidate is slower per chip than the gang's "
+        "current configuration (ledger medians)",
+        findings=findings, reason="unprofitable")
+
+
+def check_grow(current_np, available_np, *, resume_dir=None,
+               latest_step=None, history=None, margin=None,
+               max_np=None):
+    """Feasibility + profitability gate for an autonomous grow. Raises
+    the typed :class:`ElasticGrowRefused` when the grow is infeasible
+    (no checkpoint to resume the resized gang from) or unprofitable
+    (:func:`choose_np`'s ledger verdict); returns the chosen target np
+    otherwise."""
+    step = None
+    if callable(latest_step):
+        try:
+            step = latest_step()
+        except Exception:
+            step = None
+    elif latest_step is not None:
+        step = latest_step
+    elif resume_dir:
+        from sparkdl_tpu.utils.checkpoint import latest_complete_step
+
+        step = latest_complete_step(resume_dir)
+    if not resume_dir:
+        raise ElasticGrowRefused(
+            "grow refused: no checkpoint directory configured "
+            f"({RESUME_DIR_ENV} unset) — a resized gang would restart "
+            "from step 0", reason="no_checkpoint",
+            findings=[f"{RESUME_DIR_ENV} unset"])
+    if step is None:
+        raise ElasticGrowRefused(
+            f"grow refused: no committed checkpoint under {resume_dir} "
+            "yet — nothing for the resized gang to resume from",
+            reason="no_checkpoint",
+            findings=[f"no committed step under {resume_dir}"])
+    return choose_np(current_np, available_np, history,
+                     margin=margin, max_np=max_np)
+
+
+class ElasticController:
+    """One per supervised launch (like :class:`GangTelemetry`): watches
+    capacity and serving demand across attempts, plans resizes at
+    checkpoint boundaries, and answers the supervisor's what-np-next
+    question on every relaunch.
+
+    Driver-thread contract: :meth:`poll` runs in the launcher's
+    monitor loop; :meth:`relaunch_target` and :meth:`note_attempt` run
+    between attempts on the same thread; :meth:`status` is read from
+    /statusz HTTP threads — hence the lock.
+    """
+
+    def __init__(self, current_np=None, *, alerts=None, env=None,
+                 probe=None, clock=time.monotonic, latest_step=None,
+                 resume_dir=None):
+        env_map = os.environ if env is None else env
+        self.check_s = float(env_map.get(CHECK_S_ENV) or "2.0")
+        self.debounce_s = float(env_map.get(DEBOUNCE_S_ENV) or "10.0")
+        self.margin = float(env_map.get(MARGIN_ENV) or "0.8")
+        self.ckpt_wait_s = float(env_map.get(CKPT_WAIT_S_ENV) or "60")
+        self.max_np = int(env_map.get(MAX_NP_ENV) or 0) or None
+        self.min_np = max(1, int(env_map.get(MIN_NP_ENV) or "1"))
+        self.arbiter = _truthy(env_map.get(ARBITER_ENV))
+        self.arbiter_rules = tuple(
+            r.strip() for r in
+            (env_map.get(ARBITER_RULES_ENV)
+             or "queue_depth_growth,server_ttft").split(",")
+            if r.strip())
+        self.arbiter_chips = max(1, int(env_map.get(ARBITER_CHIPS_ENV)
+                                        or "1"))
+        self.clear_s = float(env_map.get(ARBITER_CLEAR_S_ENV) or "30")
+        self.resume_dir = (resume_dir if resume_dir is not None
+                           else (env_map.get(RESUME_DIR_ENV) or None))
+
+        self.current_np = int(current_np) if current_np else None
+        self.available_np = None
+        self._alerts = alerts
+        self._probe = probe or (lambda: probe_capacity(env))
+        self._clock = clock
+        self._latest_step_fn = latest_step
+        self._lock = threading.Lock()
+        self._next_check = 0.0
+        self._surplus_since = None
+        self._refused_at = None      # capacity the ledger said no to
+        self._pending = None         # planned resize awaiting a ckpt
+        self._clamp_reason = None
+        self._decisions = []
+        self._transitions = {}       # "direction:reason" -> count
+        self._demand_seen = 0        # arbiter-rule alert records seen
+        self._quiet_since = None
+        self._yielded = 0            # chips currently ceded to serving
+        self._pre_yield_np = None
+        self._fleet_base = None      # fleet replicas before scale-up
+
+    # ---- probes -----------------------------------------------------
+
+    def _latest_step(self):
+        if self._latest_step_fn is not None:
+            try:
+                return self._latest_step_fn()
+            except Exception:
+                return None
+        if not self.resume_dir:
+            return None
+        try:
+            from sparkdl_tpu.utils.checkpoint import (
+                latest_complete_step,
+            )
+
+            return latest_complete_step(self.resume_dir)
+        except Exception:
+            return None
+
+    def _fleet_queue_depth(self):
+        try:
+            from sparkdl_tpu.observe.statusz import fleet_status
+
+            rows = fleet_status()
+        except Exception:
+            return None
+        if not rows:
+            return None
+        return sum(int(r.get("queue_depth") or 0) for r in rows)
+
+    # ---- the monitor-loop tick --------------------------------------
+
+    def poll(self, now=None):
+        """One watcher tick (throttled to ``check_s``). Returns a
+        resize request dict — ``{"direction", "target_np", "reason",
+        "resume_step"}`` — when a planned resize has reached its
+        checkpoint boundary and the launcher should recycle the gang
+        NOW, else None."""
+        now = self._clock() if now is None else now
+        if now < self._next_check:
+            return None
+        self._next_check = now + self.check_s
+        cap = self._probe()
+        with self._lock:
+            self.available_np = cap
+            req = self._ripen_pending(now)
+            if req is not None:
+                return req
+            if self._pending is not None:
+                return None  # still waiting for the next checkpoint
+            plan = self._arbiter_plan(now)
+            if plan is not None:
+                self._plan(plan, now)
+                return None
+            self._grow_watch(now, cap)
+            return None
+
+    def _ripen_pending(self, now):
+        pend = self._pending
+        if pend is None or pend.get("emitted"):
+            return None
+        step = self._latest_step()
+        decided = pend.get("decided_step")
+        ready = step is not None and (decided is None or step > decided)
+        if not ready:
+            if now - pend["planned_at"] < self.ckpt_wait_s:
+                return None
+            if step is None:
+                # The wait expired with no checkpoint ever committed: a
+                # resize would restart the run from scratch. Cancel.
+                if pend["direction"] == "yield":
+                    self._yielded = 0
+                self._record(direction=pend["direction"],
+                             outcome="cancelled", reason="no_checkpoint",
+                             from_np=self.current_np,
+                             to_np=pend["target_np"])
+                observe.instant(
+                    "elastic.cancelled", cat="elastic",
+                    direction=pend["direction"], reason="no_checkpoint",
+                    target_np=pend["target_np"])
+                self._pending = None
+                return None
+            # Wait bounded: resume from the newest committed step even
+            # though it predates the decision.
+        pend["emitted"] = True
+        pend["resume_step"] = step
+        self._record(direction=pend["direction"], outcome="resize",
+                     reason=pend["reason"], from_np=self.current_np,
+                     to_np=pend["target_np"], resume_step=step)
+        observe.instant(
+            "elastic.decision", cat="elastic",
+            direction=pend["direction"], reason=pend["reason"],
+            from_np=self.current_np, target_np=pend["target_np"],
+            resume_step=step)
+        logger.info(
+            "elastic %s: recycling the gang np %s -> %s (%s), resuming "
+            "from step %s", pend["direction"], self.current_np,
+            pend["target_np"], pend["reason"], step)
+        if pend["direction"] == "yield":
+            self._scale_fleet(grow=True)
+        elif pend["direction"] == "reclaim":
+            self._scale_fleet(grow=False)
+        return {"direction": pend["direction"],
+                "target_np": pend["target_np"],
+                "reason": pend["reason"], "resume_step": step}
+
+    def _arbiter_plan(self, now):
+        if not self.arbiter:
+            return None
+        demand, rule = False, None
+        if self._alerts is not None:
+            try:
+                recs = [r for r in self._alerts.records()
+                        if r.get("rule") in self.arbiter_rules]
+            except Exception:
+                recs = []
+            if len(recs) > self._demand_seen:
+                self._demand_seen = len(recs)
+                demand = True
+                rule = recs[-1].get("rule")
+        depth = self._fleet_queue_depth()
+        if demand or (depth is not None and depth > 0):
+            self._quiet_since = None
+        elif self._quiet_since is None:
+            self._quiet_since = now
+        cur = self.current_np
+        if (demand and not self._yielded and cur is not None
+                and cur > self.min_np):
+            target = max(self.min_np, cur - self.arbiter_chips)
+            if target < cur:
+                self._yielded = cur - target
+                self._pre_yield_np = cur
+                return {"direction": "yield",
+                        "reason": rule or "serving_alert",
+                        "target_np": target}
+        if (self._yielded and cur is not None
+                and self._quiet_since is not None
+                and now - self._quiet_since >= self.clear_s):
+            target = self._pre_yield_np or (cur + self._yielded)
+            if self.available_np is not None:
+                target = min(target, self.available_np)
+            if target > cur:
+                self._yielded = 0
+                return {"direction": "reclaim",
+                        "reason": "alerts_clear", "target_np": target}
+        return None
+
+    def _grow_watch(self, now, cap):
+        cur = self.current_np
+        if cap is None or cur is None or self._yielded:
+            self._surplus_since = None
+            return
+        if cap <= cur:
+            # No surplus (or a dip mid-debounce): the clock restarts
+            # from zero on the next surplus — the anti-thrash rule.
+            self._surplus_since = None
+            if self._refused_at is not None and cap != self._refused_at:
+                self._refused_at = None
+            return
+        if self._surplus_since is None:
+            self._surplus_since = now
+            return
+        if now - self._surplus_since < self.debounce_s:
+            return
+        if self._refused_at == cap:
+            return  # the ledger's verdict will not change mid-run
+        try:
+            target = check_grow(
+                cur, cap, resume_dir=self.resume_dir,
+                latest_step=self._latest_step, margin=self.margin,
+                max_np=self.max_np)
+        except ElasticGrowRefused as e:
+            if e.reason == "unprofitable":
+                self._refused_at = cap
+            self._record(direction="grow", outcome="refused",
+                         reason=e.reason, from_np=cur, to_np=cap)
+            observe.instant(
+                "elastic.grow_refused", cat="elastic", current_np=cur,
+                available_np=cap, reason=e.reason,
+                problems=[str(f) for f in e.findings[:4]])
+            logger.warning("elastic grow toward np=%d refused: %s",
+                           cap, e)
+            return
+        if target > cur:
+            self._plan({"direction": "grow",
+                        "reason": "capacity_returned",
+                        "target_np": target}, now)
+
+    def _plan(self, req, now):
+        req = dict(req)
+        req["planned_at"] = now
+        req["decided_step"] = self._latest_step()
+        req["emitted"] = False
+        self._pending = req
+        observe.instant(
+            "elastic.planned", cat="elastic", direction=req["direction"],
+            reason=req["reason"], from_np=self.current_np,
+            target_np=req["target_np"])
+        logger.info(
+            "elastic %s planned: np %s -> %s (%s); waiting for the "
+            "next checkpoint boundary", req["direction"],
+            self.current_np, req["target_np"], req["reason"])
+
+    def _scale_fleet(self, grow):
+        """Move the chips the other way on a colocated serving fleet:
+        yield scales the fleet up by the yielded chips, reclaim scales
+        it back to its pre-yield size. Best-effort — a fleet that
+        cannot resize must not take down the training relaunch."""
+        try:
+            from sparkdl_tpu.observe.statusz import live_fleets
+
+            fleets = live_fleets()
+        except Exception:
+            fleets = []
+        for fleet in fleets[:1]:
+            try:
+                if grow:
+                    self._fleet_base = fleet.replica_count()
+                    target = self._fleet_base + (
+                        self._yielded or self.arbiter_chips)
+                else:
+                    target = self._fleet_base or max(
+                        1, fleet.replica_count() - self.arbiter_chips)
+                fleet.scale_to(target)
+                observe.instant(
+                    "elastic.fleet_scale", cat="elastic",
+                    replicas=target,
+                    direction="up" if grow else "down")
+            except Exception:
+                logger.warning("elastic fleet scale failed",
+                               exc_info=True)
+
+    # ---- the supervisor's relaunch questions ------------------------
+
+    def relaunch_target(self):
+        """The np the next relaunch should use, or None (keep the
+        configured np). A planned resize that reached its checkpoint
+        boundary wins; otherwise the controller preserves the current
+        world across unplanned relaunches, clamped down to the probed
+        capacity — a gang must never relaunch wider than the pod."""
+        with self._lock:
+            pend = self._pending
+            if pend is not None and pend.get("emitted"):
+                return int(pend["target_np"])
+            cur = self.current_np
+            if cur is None:
+                return None
+            cap = self._probe()
+            if cap is not None:
+                self.available_np = cap
+            target = cur
+            if cap is not None and cap < cur:
+                target = max(self.min_np, cap)
+            if target != cur:
+                self._clamp_reason = "capacity"
+            return target
+
+    def cancel_pending(self, reason):
+        """Drop a planned resize (e.g. the reshard pre-flight refused
+        its target): the relaunch proceeds at the current np."""
+        with self._lock:
+            pend, self._pending = self._pending, None
+            if pend is None:
+                return
+            if pend["direction"] == "yield":
+                self._yielded = 0
+            self._record(direction=pend["direction"],
+                         outcome="cancelled", reason=reason,
+                         from_np=self.current_np,
+                         to_np=pend.get("target_np"))
+            observe.instant(
+                "elastic.cancelled", cat="elastic",
+                direction=pend["direction"], reason=reason,
+                target_np=pend.get("target_np"))
+
+    def note_attempt(self, num_workers):
+        """Launcher hook: the resolved world size of the attempt that
+        is about to spawn. World changes land the transition counter +
+        instant; a consumed plan is cleared; the debounce clock
+        restarts (a fresh attempt re-decides from scratch)."""
+        with self._lock:
+            prev = self.current_np
+            world = int(num_workers)
+            self.current_np = world
+            pend, self._pending = self._pending, None
+            self._surplus_since = None
+            clamp, self._clamp_reason = self._clamp_reason, None
+            if prev is None or world == prev:
+                return
+            if (pend is not None and pend.get("emitted")
+                    and int(pend["target_np"]) == world):
+                direction, reason = pend["direction"], pend["reason"]
+            else:
+                direction = "shrink" if world < prev else "grow"
+                reason = clamp or "relaunch"
+            key = f"{direction}:{reason}"
+            self._transitions[key] = self._transitions.get(key, 0) + 1
+            observe.inc("gang_elastic_transitions_total",
+                        direction=direction, reason=reason)
+            observe.instant(
+                "elastic.transition", cat="elastic", direction=direction,
+                reason=reason, from_np=prev, to_np=world)
+            self._record(direction=direction, outcome="transition",
+                         reason=reason, from_np=prev, to_np=world)
+            logger.info("elastic transition: np %d -> %d (%s, %s)",
+                        prev, world, direction, reason)
+
+    # ---- introspection ----------------------------------------------
+
+    def _record(self, **kw):
+        kw["ts"] = time.time()
+        self._decisions.append(kw)
+        del self._decisions[:-200]  # keep the newest 200
+
+    def status(self):
+        """The /statusz "elastic" section: current vs available chips
+        plus the newest decisions."""
+        with self._lock:
+            pend = self._pending
+            return {
+                "enabled": True,
+                "arbiter": self.arbiter,
+                "current_np": self.current_np,
+                "available_np": self.available_np,
+                "yielded_chips": self._yielded,
+                "pending": (None if pend is None else {
+                    "direction": pend["direction"],
+                    "target_np": pend["target_np"],
+                    "reason": pend["reason"],
+                }),
+                "transitions": dict(self._transitions),
+                "decisions": list(self._decisions)[-8:],
+            }
+
+    def report(self):
+        """The run dir's ``elastic.json`` decision log."""
+        with self._lock:
+            return {
+                "schema": ELASTIC_SCHEMA,
+                "enabled": True,
+                "arbiter": self.arbiter,
+                "current_np": self.current_np,
+                "available_np": self.available_np,
+                "yielded_chips": self._yielded,
+                "transitions": dict(self._transitions),
+                "decisions": list(self._decisions),
+            }
+
+
+# One active controller per driver process (mirrors the launcher's
+# single supervised gang at a time); the supervisor consults it for
+# relaunch targets without threading it through every signature.
+_active = None
+
+
+def set_active_controller(controller):
+    global _active
+    _active = controller
+
+
+def active_controller():
+    return _active
+
+
+def _reset_for_tests():
+    global _active
+    _active = None
+
+
+def maybe_make_controller(current_np=None, *, alerts=None, env=None):
+    """The zero-overhead latch: None unless ``SPARKDL_TPU_ELASTIC`` is
+    truthy — the monitor loop's ``is not None`` test is the whole cost
+    of the feature when it is off."""
+    env_map = os.environ if env is None else env
+    if not _truthy(env_map.get(ELASTIC_ENV)):
+        return None
+    return ElasticController(current_np, alerts=alerts, env=env)

@@ -487,6 +487,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     # Artifacts are written in the finally — a gang that exhausts its
     # retry budget leaves its telemetry behind for the postmortem.
     telemetry = None
+    alert_engine = None
     if observe.enabled():
         from sparkdl_tpu.observe.aggregate import GangTelemetry
 
@@ -495,15 +496,56 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
             telemetry.add_comms_reports(comms_reports)
         if fixit_reports:
             telemetry.add_fixit_reports(fixit_reports)
+        # Streaming alert engine (ISSUE 14; SPARKDL_TPU_ALERTS): ONE
+        # engine spans every supervised attempt, like the telemetry
+        # aggregator — an elastic gang that resizes between attempts
+        # keeps its alert history while the per-rank state is rebuilt
+        # via set_world() per attempt (observe/alerts.py).
+        from sparkdl_tpu.observe.alerts import maybe_make_engine
+
+        alert_engine = maybe_make_engine(telemetry)
+    # Autonomous elasticity (ISSUE 16; SPARKDL_TPU_ELASTIC): the
+    # capacity watcher / chip-budget arbiter also spans every attempt.
+    # It is consulted by the supervisor for relaunch targets via the
+    # module-level active-controller registration, and polled in the
+    # monitor loop below for planned (checkpoint-boundary) resizes.
+    from sparkdl_tpu.horovod.elastic import (
+        maybe_make_controller,
+        set_active_controller,
+    )
+
+    controller = maybe_make_controller(alerts=alert_engine)
+    if controller is not None:
+        set_active_controller(controller)
     try:
         return supervise(
             lambda extra_env: _launch_gang_once(
                 np, main, kwargs, driver_log_verbosity, per_rank_kwargs,
                 extra_env=extra_env, telemetry=telemetry,
+                alert_engine=alert_engine, controller=controller,
             ),
             RetryPolicy.from_env(),
         )
     finally:
+        if controller is not None:
+            set_active_controller(None)
+        if telemetry is not None and alert_engine is not None:
+            # The report is attached even when nothing fired: a clean
+            # run's alerts.json proves the rules were evaluated (the
+            # false-positive guard is auditable).
+            try:
+                telemetry.add_alert_report(alert_engine.report())
+            except Exception:
+                logger.warning("alert report attach failed",
+                               exc_info=True)
+        if telemetry is not None and controller is not None:
+            # The elastic decision log — every grow/yield/reclaim with
+            # its reason — lands in the run dir's elastic.json.
+            try:
+                telemetry.add_elastic_report(controller.report())
+            except Exception:
+                logger.warning("elastic report attach failed",
+                               exc_info=True)
         # Guard the dir re-read too: the write must NEVER mask the
         # gang's own result/exception, even if the env vanished
         # mid-run (tests) or the dir is unwritable.
@@ -524,7 +566,8 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
 
 def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                       per_rank_kwargs=None, extra_env=None,
-                      telemetry=None):
+                      telemetry=None, alert_engine=None,
+                      controller=None):
     import cloudpickle
 
     from sparkdl_tpu import observe
@@ -596,6 +639,11 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             f"gang of {num_workers}"
         )
     record_attempt_world(num_workers)
+    if controller is not None:
+        # World-size transitions (shrink/grow/yield/reclaim) are
+        # counted here, where the resolved size of the attempt is
+        # known; a consumed resize plan is cleared.
+        controller.note_attempt(num_workers)
 
     # Remote-transport availability is knowable NOW — before the slot
     # claim (which can wait minutes for busy slots) and before any
@@ -649,23 +697,16 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
     # classifies as the transient HANG cause.
     detector = None
     statusz = None
-    alert_engine = None
     if telemetry is not None:
         from sparkdl_tpu.observe.health import HangDetector
 
         detector = HangDetector(num_workers)
-        # Live tier (ISSUE 14), both behind their own env latches on
-        # top of the telemetry opt-in: the statusz HTTP server
-        # (SPARKDL_TPU_STATUSZ_PORT — live /metrics, /statusz,
-        # /events against THIS attempt's merged state) and the
-        # streaming alert engine (SPARKDL_TPU_ALERTS — evaluated in
-        # the monitor loop below, findings written to the run dir's
-        # alerts.json). With neither env set these are None: no
-        # thread, no socket, no rule evaluation.
-        from sparkdl_tpu.observe.alerts import maybe_make_engine
-
-        alert_engine = maybe_make_engine(
-            telemetry, detector=detector, num_workers=num_workers)
+        if alert_engine is not None:
+            # The engine spans attempts (created in launch_gang); the
+            # per-rank baselines/latches are rebuilt for THIS
+            # attempt's world size — an elastic gang that shrank or
+            # grew must not judge new ranks by a dead rank's history.
+            alert_engine.set_world(num_workers, detector=detector)
 
     slot_claim = None
     if mode == "cluster":
@@ -697,7 +738,7 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
 
             statusz = maybe_start_statusz(
                 telemetry, detector=detector, num_workers=num_workers,
-                alerts=alert_engine)
+                alerts=alert_engine, elastic=controller)
             if statusz is not None:
                 logger.info("statusz live at http://%s/statusz",
                             statusz.address)
@@ -965,9 +1006,34 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 # Streaming SLO rules over the live telemetry window
                 # (throttled internally to its check cadence). Firings
                 # land as alert.* instants + gang_alerts_total here;
-                # the merged report is attached to the run dir in the
-                # finally below.
+                # the merged report is attached to the run dir in
+                # launch_gang's finally.
                 alert_engine.poll()
+            if controller is not None and first_death is None:
+                # Elastic tick (throttled internally): capacity watch,
+                # debounce, arbiter. A non-None return means a planned
+                # resize reached its checkpoint boundary — recycle the
+                # gang NOW; the supervisor classifies the typed
+                # elastic_resize kind as a zero-budget, zero-backoff
+                # relaunch at the controller's target np.
+                resize = controller.poll()
+                if resize is not None:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    for p in procs:
+                        p.wait()
+                    err = GangFailure(
+                        f"elastic resize: {resize['direction']} to "
+                        f"np={resize['target_np']} "
+                        f"({resize['reason']}); resuming from step "
+                        f"{resize.get('resume_step')}",
+                        kind="elastic_resize",
+                        exit_codes=[p.poll() or 0 for p in procs],
+                    )
+                    err.elastic_direction = resize["direction"]
+                    err.elastic_target = resize["target_np"]
+                    raise err
             if detector is not None and first_death is None:
                 report = detector.poll()
                 for r in report["new_stalled"]:
@@ -1058,11 +1124,6 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             # Stop serving BEFORE the teardown below: a scrape racing
             # the kill path would read half-dismantled state.
             statusz.close()
-        if alert_engine is not None and telemetry is not None:
-            # The report is attached even when nothing fired: a clean
-            # run's alerts.json proves the rules were evaluated (the
-            # false-positive guard is auditable).
-            telemetry.add_alert_report(alert_engine.report())
         if detector is not None and telemetry is not None:
             # However this attempt ended, its detector state (per-rank
             # last beat/step/collective, any verdicts) goes into the

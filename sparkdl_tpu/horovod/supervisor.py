@@ -110,18 +110,46 @@ RESHARD_TARGET_AXES_ENV = "SPARKDL_TPU_RESHARD_TARGET_AXES"
 # World size of every launch attempt in this driver process, in order
 # (the launcher records each resolved gang size). Feeds the /statusz
 # supervisor section so a shrunken gang is visible in mission control:
-# current attempt's world vs the previous attempt's.
+# current attempt's world vs the previous attempt's. The parallel
+# stamps list (wall-clock start of each attempt) feeds the chip-hour
+# utilization view; kept separate so tests that monkeypatch
+# _attempt_worlds alone keep working.
 _attempt_worlds = []
+_attempt_stamps = []
 
 
 def record_attempt_world(num_workers):
     """Launcher hook: one resolved gang size per launch attempt."""
     _attempt_worlds.append(int(num_workers))
+    _attempt_stamps.append(time.time())
 
 
 def attempt_world_sizes():
     """World sizes of this driver's launch attempts, oldest first."""
     return list(_attempt_worlds)
+
+
+def attempt_chip_hours(now=None):
+    """Chip-hours per attempt (world size x attempt wall duration):
+    the /statusz utilization ledger of what an elastic run actually
+    spent. The last attempt is priced up to ``now``. Attempts whose
+    start stamp is unknown (tests monkeypatching _attempt_worlds)
+    price as None rather than guessing."""
+    now = time.time() if now is None else now
+    out = []
+    for i, world in enumerate(_attempt_worlds):
+        t0 = _attempt_stamps[i] if i < len(_attempt_stamps) else None
+        if t0 is None:
+            out.append({"attempt": i + 1, "world": world,
+                        "chip_hours": None})
+            continue
+        t1 = (_attempt_stamps[i + 1]
+              if i + 1 < len(_attempt_stamps) else now)
+        out.append({
+            "attempt": i + 1, "world": world,
+            "chip_hours": round(world * max(0.0, t1 - t0) / 3600.0, 6),
+        })
+    return out
 
 TRANSIENT = "transient"
 PERMANENT = "permanent"
@@ -277,6 +305,22 @@ def classify_failure(exc):
     if isinstance(exc, (ValueError, TypeError)):
         return PERMANENT, f"bad arguments ({type(exc).__name__})"
     if isinstance(exc, GangFailure):
+        if exc.kind == "elastic_resize":
+            # Not a failure at all: the elastic controller asked the
+            # launcher to recycle the gang at a new np after a
+            # checkpoint boundary (capacity returned, or the chip
+            # arbiter moved chips between training and serving). The
+            # relaunch is the whole point — transient by construction,
+            # and the supervise loop charges it zero retry budget and
+            # zero backoff. Checked FIRST for the same reason as hang:
+            # the launcher's own kill makes the exit codes look
+            # signal-killed.
+            return TRANSIENT, (
+                f"ELASTIC ({getattr(exc, 'elastic_direction', 'resize')}"
+                f") — planned resize to "
+                f"np={getattr(exc, 'elastic_target', '?')}; relaunching "
+                "from checkpoint"
+            )
         if exc.kind == "hang":
             # The hang detector declared the gang wedged (one rank
             # stuck in a collective, a stalled host callback...) and
@@ -372,20 +416,33 @@ def _resume_step(policy):
 
 
 def _relaunch_np_target():
-    """The operator's elastic-relaunch target np, or None (unset or
-    unparsable — the latter is logged, never fatal: a typo must not
-    take down an otherwise-recoverable gang)."""
+    """The elastic-relaunch target np, or None (keep the configured
+    np). The operator's env knob always wins; with it unset, the
+    active :class:`~sparkdl_tpu.horovod.elastic.ElasticController` (if
+    any) answers — a planned resize's target, or the current world
+    clamped to the probed capacity. Unparsable operator input is
+    logged, never fatal: a typo must not take down an otherwise-
+    recoverable gang."""
     raw = os.environ.get(RELAUNCH_NP_ENV)
-    if not raw:
-        return None
-    try:
-        return int(raw)
-    except ValueError:
-        logger.warning(
-            "ignoring unparsable %s=%r (want an integer np)",
-            RELAUNCH_NP_ENV, raw,
-        )
-        return None
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            logger.warning(
+                "ignoring unparsable %s=%r (want an integer np)",
+                RELAUNCH_NP_ENV, raw,
+            )
+            return None
+    from sparkdl_tpu.horovod import elastic
+
+    ctrl = elastic.active_controller()
+    if ctrl is not None:
+        try:
+            return ctrl.relaunch_target()
+        except Exception:
+            logger.warning("elastic relaunch-target probe failed",
+                           exc_info=True)
+    return None
 
 
 def _reshard_preflight(target_np):
@@ -476,7 +533,9 @@ def supervise(launch, policy, _sleep=time.sleep):
 
     attempts = []
     attempt = 1
+    budget_used = 0  # only UNPLANNED transient failures consume budget
     del _attempt_worlds[:]  # fresh story per supervised launch
+    del _attempt_stamps[:]
     while True:
         extra_env = {}
         if attempt > 1:
@@ -507,18 +566,36 @@ def supervise(launch, policy, _sleep=time.sleep):
             return launch(extra_env)
         except Exception as e:
             verdict, cause = classify_failure(e)
+            planned = getattr(e, "kind", None) == "elastic_resize"
             attempts.append(AttemptRecord(attempt, verdict, cause))
-            # Every AttemptRecord lands on the gang timeline with its
-            # classify_failure verdict — the "classified transient"
-            # beat of a chaos run's story — and in the metric view
-            # (gang_failures_total by verdict, alertable).
-            observe.instant(
-                "gang.failure", cat="supervisor", attempt=attempt,
-                verdict=verdict, cause=cause,
-                kind=getattr(e, "kind", type(e).__name__),
-            )
-            observe.inc("gang_failures_total", verdict=verdict)
             first_line = (str(e).splitlines() or ["<no message>"])[0]
+            if planned:
+                # A controller-requested resize, not a failure: no
+                # failure instant/counter, no budget charge, no
+                # backoff — the checkpoint-boundary wait already
+                # happened before the launcher recycled the gang.
+                observe.instant(
+                    "gang.resize", cat="supervisor", attempt=attempt,
+                    cause=cause,
+                    direction=getattr(e, "elastic_direction", None),
+                    target_np=getattr(e, "elastic_target", None),
+                )
+                logger.info(
+                    "HorovodRunner gang recycling for a planned "
+                    "elastic resize (attempt %d: %s)", attempt, cause,
+                )
+            else:
+                # Every AttemptRecord lands on the gang timeline with
+                # its classify_failure verdict — the "classified
+                # transient" beat of a chaos run's story — and in the
+                # metric view (gang_failures_total by verdict,
+                # alertable).
+                observe.instant(
+                    "gang.failure", cat="supervisor", attempt=attempt,
+                    verdict=verdict, cause=cause,
+                    kind=getattr(e, "kind", type(e).__name__),
+                )
+                observe.inc("gang_failures_total", verdict=verdict)
             if verdict == PERMANENT:
                 logger.error(
                     "HorovodRunner gang failed permanently on attempt "
@@ -526,19 +603,38 @@ def supervise(launch, policy, _sleep=time.sleep):
                     attempt, cause, first_line,
                 )
                 raise
-            if attempt > policy.max_retries:
-                if policy.max_retries > 0:
-                    raise GangRetryBudgetExhausted(
-                        attempts, policy.max_retries
-                    ) from e
-                raise  # supervision off: surface the failure untouched
+            if not planned:
+                budget_used += 1
+                if budget_used > policy.max_retries:
+                    if policy.max_retries > 0:
+                        raise GangRetryBudgetExhausted(
+                            attempts, policy.max_retries
+                        ) from e
+                    raise  # supervision off: surface untouched
             target_np = _relaunch_np_target()
             if target_np is not None:
-                # Elastic relaunch: feasibility-check the shrunken
+                # Elastic relaunch: feasibility-check the resized
                 # mesh BEFORE paying the backoff sleep — an
                 # infeasible target raises the typed refusal here.
-                _reshard_preflight(target_np)
-            delay = policy.backoff(attempt)
+                # A controller-planned target that fails pre-flight
+                # is cancelled instead (the relaunch proceeds at the
+                # current np); only the operator's explicit env
+                # target escalates the refusal.
+                try:
+                    _reshard_preflight(target_np)
+                except Exception:
+                    if os.environ.get(RELAUNCH_NP_ENV):
+                        raise
+                    from sparkdl_tpu.horovod import elastic
+
+                    ctrl = elastic.active_controller()
+                    if ctrl is None:
+                        raise
+                    ctrl.cancel_pending("reshard_preflight_refused")
+            if planned:
+                delay = 0.0
+            else:
+                delay = policy.backoff(budget_used)
             # Recomputed at the top of the next iteration too (listdir
             # is cheap); shown here so the operator sees the resume
             # point BEFORE the backoff sleep, not after.
@@ -548,18 +644,23 @@ def supervise(launch, policy, _sleep=time.sleep):
             )
 
             warm = os.environ.get(COMPILE_CACHE_DIR_ENV)
-            logger.warning(
-                "HorovodRunner gang failed transiently (attempt %d/%d: "
-                "%s); relaunching in %.1fs%s%s: %s",
-                attempt, policy.max_retries + 1, cause, delay,
-                "" if resume is None
-                else f" (will resume from step {resume})",
-                "" if not warm else " (compile cache warm)",
-                first_line,
-            )
+            if not planned:
+                logger.warning(
+                    "HorovodRunner gang failed transiently (attempt "
+                    "%d, retry %d/%d: %s); relaunching in %.1fs%s%s: "
+                    "%s",
+                    attempt, budget_used, policy.max_retries, cause,
+                    delay,
+                    "" if resume is None
+                    else f" (will resume from step {resume})",
+                    "" if not warm else " (compile cache warm)",
+                    first_line,
+                )
             observe.inc("gang_restarts_total")
-            with observe.span("gang.backoff", cat="supervisor",
-                              attempt=attempt, delay_s=round(delay, 3),
-                              resume_step=resume):
-                _sleep(delay)
+            if delay > 0:
+                with observe.span("gang.backoff", cat="supervisor",
+                                  attempt=attempt,
+                                  delay_s=round(delay, 3),
+                                  resume_step=resume):
+                    _sleep(delay)
             attempt += 1

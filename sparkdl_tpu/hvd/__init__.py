@@ -134,12 +134,14 @@ def allreduce_async(tensor, average=None, name=None, op=None):
         next_logits = forward(next_batch)       # compute overlaps it
         grads = handle.result()                 # serialized tail only
 
-    Ordering contract (see ``AsyncCollective``): every rank must
-    submit the same async sequence, and no *synchronous* gang
-    collective may interleave between a submit and its resolution.
+    Ordering contract (see ``AsyncCollective``): the collective is
+    enqueued with XLA before this returns, on the calling thread, so
+    its cross-rank order is the caller's program order — other gang
+    collectives may run between the submit and its resolution, as
+    long as every rank runs the same program.
 
     With telemetry opted in this is the measured half of ROADMAP item
-    3's overlap arc: the collective span lands on the dispatch thread
+    3's overlap arc: the collective span lands on the wait thread
     (overlapped time in ``observe.perf``'s attribution), the residual
     ``result()`` blocking on the caller's thread (serialized time) —
     together, ``overlap_efficiency``.
@@ -149,20 +151,22 @@ def allreduce_async(tensor, average=None, name=None, op=None):
     kind = _resolve_op(average, op)
     eng = engine()
     if _concrete_single_device_jax(tensor):
-        # jax.Arrays are immutable — safe to read from the dispatch
-        # thread without a copy
+        # jax.Arrays are immutable — safe to dispatch from without a
+        # copy
         return eng.submit_async(
-            "reduce_jax", eng.reduce_jax, tensor, kind)
-    # COPY the host buffer before handing it to the dispatch thread:
-    # the canonical caller mutates its grads in place while the hop is
-    # in flight (that is the whole point), and a zero-copy view would
-    # let the reduce read a rank-dependent mix of old and new values.
+            "reduce_jax", lambda: eng.reduce_jax_start(tensor, kind),
+            nbytes=int(getattr(tensor, "nbytes", 0) or 0))
+    # COPY the host buffer before the dispatch reads it: the canonical
+    # caller mutates its grads in place while the hop is in flight
+    # (that is the whole point), and a zero-copy view would let the
+    # reduce read a rank-dependent mix of old and new values.
     x = np.array(to_numpy(tensor), order="C", copy=True)
 
-    def run():
-        return from_numpy_like(eng.reduce(x, kind), tensor)
+    def start():
+        finish = eng.reduce_start(x, kind)
+        return lambda: from_numpy_like(finish(), tensor)
 
-    return eng.submit_async("reduce", run)
+    return eng.submit_async("reduce", start, nbytes=int(x.nbytes))
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None):

@@ -96,12 +96,14 @@ class AsyncCollective:
     thread — the serialized tail the overlap failed to hide. Together
     they are the measured ``overlap_efficiency``.
 
-    Ordering contract: async collectives execute in submission order
-    on every rank (one dispatch thread per process), so gangs stay
-    aligned as long as every rank submits the same sequence. Do NOT
-    interleave a *synchronous* gang collective between a submit and
-    its resolution — the two threads would race for the interconnect
-    in rank-dependent order.
+    Ordering contract: the collective is ENQUEUED with XLA on the
+    submitting thread itself (``submit_async`` runs the dispatch half
+    before it returns), so the cross-rank collective order is the
+    caller's program order — every rank runs the same program, so
+    every rank's backend sees the same sequence even when other gang
+    collectives (a synchronous allreduce, a shard_map ppermute ring)
+    dispatch from the step thread between a submit and its
+    resolution. Only the blocking wait rides the background thread.
     """
 
     def __init__(self, future, op_name):
@@ -153,9 +155,10 @@ class _CollectiveEngine:
         self._async_pool = None
 
     def _ensure_async_pool(self):
-        """ONE dispatch thread per process: async collectives execute
-        in submission order everywhere, so a gang that submits the
-        same sequence on every rank cannot deadlock itself."""
+        """ONE wait thread per process: it only blocks for results
+        (the dispatch already happened on the submitting thread), so
+        async waits resolve in submission order and their wire time
+        lands on a non-step thread in the perf attribution."""
         if self._async_pool is not None:
             return self._async_pool
         with self._lock:
@@ -168,14 +171,46 @@ class _CollectiveEngine:
                 )
         return self._async_pool
 
-    def submit_async(self, op_name, fn, *args, **kwargs):
-        """Run ``fn`` (one of the public collective ops, or a closure
-        over one) on the background dispatch thread; returns an
-        :class:`AsyncCollective`. The op's ``@_observed`` span lands on
-        the dispatch thread — overlapped collective time in the perf
-        attribution."""
+    def submit_async(self, op_name, start, nbytes=0):
+        """Run ``start`` NOW, on the calling thread — it enqueues the
+        collective with XLA and returns a blocking ``finish`` thunk —
+        then hand only that thunk to the background thread, where the
+        wire wait lands as the overlapped ``cat="collective"`` span.
+
+        Dispatching on the pool thread instead (the original shape)
+        let the step thread's own jitted collectives race the submit
+        into rank-DEPENDENT backend order: rank 0 enqueues
+        [psum, ppermute] while rank 1 enqueues [ppermute, psum], each
+        side's transport waits on an op the peer hasn't issued, and
+        the gang deadlocks — readily reproduced on a single-core rig
+        where thread scheduling is coarse. Enqueueing before
+        ``submit_async`` returns pins the order to program order,
+        which is identical on every rank by construction."""
+        finish = start()
         pool = self._ensure_async_pool()
-        return AsyncCollective(pool.submit(fn, *args, **kwargs), op_name)
+        if not observe.enabled():
+            return AsyncCollective(pool.submit(finish), op_name)
+        from sparkdl_tpu.observe import health
+
+        def finish_observed():
+            # Mirrors @_observed for the wait half: progress markers
+            # for the hang detector, per-op metrics, and the timeline
+            # span perf.py attributes as overlapped collective time.
+            health.note_collective(op_name)
+            wall0 = time.time()
+            t0 = time.perf_counter()
+            out = finish()
+            dt = time.perf_counter() - t0
+            health.note_collective(op_name, done=True)
+            observe.inc("collective_ops_total", op=op_name)
+            observe.inc("collective_bytes_total", value=int(nbytes),
+                        op=op_name)
+            observe.observe_value("collective_seconds", dt, op=op_name)
+            observe.complete(op_name, wall0, dt, cat="collective",
+                             op=op_name, bytes=int(nbytes))
+            return out
+
+        return AsyncCollective(pool.submit(finish_observed), op_name)
 
     def _ensure_mesh(self):
         import jax
@@ -328,11 +363,18 @@ class _CollectiveEngine:
 
     # -- public ops ---------------------------------------------------------
 
-    @_observed("reduce")
-    def reduce(self, x_np, op):
+    def reduce_start(self, x_np, op):
+        """Dispatch half of :meth:`reduce`: resolve the compiled
+        program and ENQUEUE the collective on the calling thread —
+        pinning its cross-rank order to program order — and return a
+        ``finish()`` thunk that blocks for the wire and materializes
+        the reduced numpy array (:meth:`submit_async` runs that half
+        on the wait thread; :meth:`reduce` runs it inline)."""
         st = _state.state()
         if st.size == 1:
-            return x_np.copy() if op != AVERAGE else x_np.astype(x_np.dtype)
+            out = (x_np.copy() if op != AVERAGE
+                   else x_np.astype(x_np.dtype))
+            return lambda: out
         # Float averages divide in-graph ("avg" kind); integer/bool
         # averages keep the host path (horovod's truncate-back-to-int
         # semantics need the float64 detour).
@@ -341,40 +383,47 @@ class _CollectiveEngine:
             "avg" if in_graph_avg
             else "sum" if op in (SUM, AVERAGE) else op
         )
-        squeeze_bool = x_np.dtype == np.bool_
+        src_dtype = x_np.dtype
+        squeeze_bool = src_dtype == np.bool_
         if squeeze_bool:
             x_np = x_np.astype(np.uint8)
         fn = self._compiled(kind, x_np.shape, x_np.dtype)
-        out = self._local_out(fn(self._to_global(x_np)))
-        if op == AVERAGE and not in_graph_avg:
-            if np.issubdtype(out.dtype, np.integer):
-                out = out.astype(np.float64)
-            out = out / st.size
-            out = out.astype(x_np.dtype) if not squeeze_bool else out
-        elif in_graph_avg:
-            # XLA may canonicalize the compute dtype (f64 -> f32 with
-            # x64 disabled); the caller's dtype is the contract. copy
-            # is a no-op when the dtype already matches.
-            out = out.astype(x_np.dtype, copy=False)
-        if squeeze_bool:
-            out = out.astype(np.bool_)
-        return out
+        pending = fn(self._to_global(x_np))
 
-    @_observed("reduce_jax")
-    def reduce_jax(self, x, op):
-        """Allreduce a DEVICE-RESIDENT ``jax.Array`` without any host
-        crossing: assembling the global array from the local shard is
-        metadata-only, the collective is the same compiled shard_map
-        psum, and the returned array stays on this process's device.
-        This is the fast path for framework grads that already live on
-        the chip (keras-3-jax custom loops, dlpack'd torch tensors)."""
+        def finish():
+            out = self._local_out(pending)
+            if op == AVERAGE and not in_graph_avg:
+                if np.issubdtype(out.dtype, np.integer):
+                    out = out.astype(np.float64)
+                out = out / st.size
+                out = out.astype(src_dtype) if not squeeze_bool else out
+            elif in_graph_avg:
+                # XLA may canonicalize the compute dtype (f64 -> f32
+                # with x64 disabled); the caller's dtype is the
+                # contract. copy is a no-op when the dtype already
+                # matches.
+                out = out.astype(src_dtype, copy=False)
+            if squeeze_bool:
+                out = out.astype(np.bool_)
+            return out
+
+        return finish
+
+    @_observed("reduce")
+    def reduce(self, x_np, op):
+        return self.reduce_start(x_np, op)()
+
+    def reduce_jax_start(self, x, op):
+        """Dispatch half of :meth:`reduce_jax` (same split contract as
+        :meth:`reduce_start`): the collective is enqueued HERE, the
+        returned ``finish()`` only blocks for the device result."""
         import jax
 
         import jax.numpy as jnp
 
         st = _state.state()
         if st.size == 1:
-            return x
+            return lambda: x
         self._ensure_mesh()
         in_graph_avg = op == AVERAGE and _is_float_dtype(x.dtype)
         if op == AVERAGE and not in_graph_avg:
@@ -382,8 +431,9 @@ class _CollectiveEngine:
             # truncation semantics; rare for device-resident tensors.
             # Re-wrap as a jax.Array: reduce_jax's contract is
             # jax.Array in, jax.Array out.
-            return jax.device_put(
-                self.reduce(np.asarray(x), op), self._local_device
+            host_finish = self.reduce_start(np.asarray(x), op)
+            return lambda: jax.device_put(
+                host_finish(), self._local_device
             )
         kind = "avg" if in_graph_avg else (
             "sum" if op in (SUM, AVERAGE) else op
@@ -403,9 +453,26 @@ class _CollectiveEngine:
             [local],
         )
         out = fn(global_arr).addressable_shards[0].data
-        if squeeze_bool:
-            out = out.astype(jnp.bool_)
-        return out
+
+        def finish():
+            got = out
+            if hasattr(got, "block_until_ready"):
+                got = got.block_until_ready()
+            if squeeze_bool:
+                got = got.astype(jnp.bool_)
+            return got
+
+        return finish
+
+    @_observed("reduce_jax")
+    def reduce_jax(self, x, op):
+        """Allreduce a DEVICE-RESIDENT ``jax.Array`` without any host
+        crossing: assembling the global array from the local shard is
+        metadata-only, the collective is the same compiled shard_map
+        psum, and the returned array stays on this process's device.
+        This is the fast path for framework grads that already live on
+        the chip (keras-3-jax custom loops, dlpack'd torch tensors)."""
+        return self.reduce_jax_start(x, op)()
 
     @_observed("allgather")
     def allgather(self, x_np):

@@ -388,6 +388,7 @@ class FleetFrontend:
         self.metrics = Registry()
         self._workers = [EngineWorker(i, engine_factory, self.metrics)
                          for i in range(replicas)]
+        self._next_replica = replicas
         self._restarts = 0
         self._shutdown = threading.Event()
         self._workers_lock = threading.Lock()
@@ -616,18 +617,23 @@ class FleetFrontend:
                 # second wedge during a respawn still gets drained
                 # within its own hang window
                 threading.Thread(
-                    target=self._respawn, args=(i, w.replica),
+                    target=self._respawn, args=(w,),
                     name=f"sparkdl-fleet-respawn-{w.replica}",
                     daemon=True).start()
 
-    def _respawn(self, slot, replica):
-        """Build a fresh replica and install it (the wedged thread, if
-        any, is left to die a daemon's death; the REPLICA identity
-        moves to the fresh engine). A failing factory must not shrink
-        the fleet forever: the slot is re-armed so the monitor retries
-        on its poll cadence, with every attempt counted."""
+    def _respawn(self, old):
+        """Build a fresh replica and install it in the dead worker's
+        place (the wedged thread, if any, is left to die a daemon's
+        death; the REPLICA identity moves to the fresh engine). Keyed
+        by worker IDENTITY, not list index — an elastic ``scale_to``
+        can reorder or drop slots while the factory runs, and
+        installing over the wrong slot would orphan a live replica. A
+        failing factory must not shrink the fleet forever: the slot is
+        re-armed so the monitor retries on its poll cadence, with
+        every attempt counted."""
         try:
-            fresh = EngineWorker(replica, self._factory, self.metrics)
+            fresh = EngineWorker(old.replica, self._factory,
+                                 self.metrics)
         except Exception:
             self.metrics.counter(
                 "server_replica_respawn_failures_total").inc()
@@ -636,7 +642,8 @@ class FleetFrontend:
                 # death path next poll — paced retry, never a silent
                 # permanent shrink (a broken factory shows up as this
                 # failure counter climbing alongside restarts)
-                self._workers[slot].restart_cause = None
+                if old in self._workers:
+                    old.restart_cause = None
             return
         # install under the workers lock with a shutdown re-check:
         # close() snapshots the worker list under this same lock
@@ -645,9 +652,68 @@ class FleetFrontend:
         with self._workers_lock:
             if self._shutdown.is_set():
                 return
+            try:
+                slot = self._workers.index(old)
+            except ValueError:
+                # scaled away mid-respawn — the fleet no longer wants
+                # this slot; the unstarted fresh worker just drops
+                return
             fresh.start()
             self._restarts += 1
             self._workers[slot] = fresh
+
+    # -- elastic scaling -----------------------------------------------
+
+    def replica_count(self):
+        """Current replica slot count (alive or respawning)."""
+        with self._workers_lock:
+            return len(self._workers)
+
+    def scale_to(self, n):
+        """Resize the fleet to ``n`` replica slots (ISSUE 16: the
+        chip-budget arbiter's lever — training yields chips, the fleet
+        grows; training reclaims, it shrinks back). Grow appends fresh
+        engines with new replica numbers; shrink retires the
+        highest-numbered slots, stopping them OUTSIDE the workers lock
+        (drain can take an inference's worth of time). Returns the new
+        slot count. No-op (returning the current count) after
+        shutdown."""
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        grown = []
+        while True:
+            with self._workers_lock:
+                need = n - len(self._workers)
+            if need <= 0:
+                break
+            # build outside the lock — engine construction can take
+            # seconds and request dispatch must keep flowing
+            w = EngineWorker(self._next_replica, self._factory,
+                             self.metrics)
+            with self._workers_lock:
+                if self._shutdown.is_set():
+                    return len(self._workers)
+                if len(self._workers) >= n:
+                    break
+                self._next_replica += 1
+                w.start()
+                self._workers.append(w)
+                grown.append(w.replica)
+        retired = []
+        with self._workers_lock:
+            if self._shutdown.is_set():
+                return len(self._workers)
+            while len(self._workers) > n:
+                retired.append(self._workers.pop())
+        for w in retired:
+            w.stop()
+        for w in retired:
+            w.join(timeout=10)
+        if grown or retired:
+            self.metrics.counter(
+                "server_fleet_scalings_total",
+                direction="grow" if grown else "shrink").inc()
+        return self.replica_count()
 
     # -- lifecycle ----------------------------------------------------
 

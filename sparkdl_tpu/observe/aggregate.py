@@ -47,6 +47,7 @@ PERF_FILE = "perf.json"
 COMMS_FILE = "comms_report.json"
 FIXIT_FILE = "fixit_report.json"
 ALERTS_FILE = "alerts.json"
+ELASTIC_FILE = "elastic.json"
 
 # Live event journal bound: the statusz SSE tail and the alert
 # engine's rolling windows only ever need the recent past, so the
@@ -78,6 +79,7 @@ class GangTelemetry:
         self._comms_reports = []    # static comms budgets (pre-flight)
         self._fixit_reports = []    # verified fixit reports (pre-flight)
         self._alert_reports = []    # one alert-engine report per attempt
+        self._elastic_reports = []  # elastic-controller decision logs
         # Live journal: every ingested worker event, in arrival order,
         # with a monotonically increasing seq — the feed behind the
         # statusz `/events` SSE tail and the alert engine's rolling
@@ -171,6 +173,16 @@ class GangTelemetry:
         if isinstance(report, dict):
             with self._lock:
                 self._alert_reports.append(report)
+
+    def add_elastic_report(self, report):
+        """The elastic controller's decision log (ISSUE 16) — one
+        report per supervised launch (the controller spans attempts),
+        written to ``elastic.json`` so every grow/yield/reclaim
+        decision is auditable from the run dir and ``observe.doctor``
+        can render the decision history post-hoc."""
+        if isinstance(report, dict):
+            with self._lock:
+                self._elastic_reports.append(report)
 
     # -- live views (statusz / alert engine) ---------------------------------
 
@@ -353,6 +365,15 @@ class GangTelemetry:
             comms = list(self._comms_reports)
             fixit = list(self._fixit_reports)
             alert_reports = list(self._alert_reports)
+            elastic_reports = list(self._elastic_reports)
+        if elastic_reports:
+            # Same merge shape as alerts: newest config/state wins,
+            # decisions concatenate across reports.
+            merged = dict(elastic_reports[-1])
+            merged["decisions"] = [d for rep in elastic_reports
+                                   for d in rep.get("decisions", ())]
+            merged["reports"] = len(elastic_reports)
+            files.append((ELASTIC_FILE, json.dumps(merged, indent=2)))
         if alert_reports:
             # Merge across attempts: newest report's config (rules,
             # window — they only change with env, but the last attempt

@@ -53,6 +53,13 @@ not a page storm):
     growing faster than ``SPARKDL_TPU_ALERT_QUEUE_GROWTH`` per
     second over the window (dormant unless the knob is set —
     growth-rate floors are workload-specific).
+``server_ttft`` (warning)
+    Any in-process registered fleet's p99 time-to-first-token —
+    estimated from its ``server_ttft_seconds`` histogram buckets —
+    exceeds ``SPARKDL_TPU_ALERT_TTFT_P99_S`` seconds (dormant unless
+    set — TTFT SLOs are workload-specific). With the chip-budget
+    arbiter on (ISSUE 16), this firing is a demand signal: training
+    yields chips to the fleet.
 ``mfu_drop`` (warning)
     Any rank's live ``mfu`` gauge fell below
     ``SPARKDL_TPU_ALERT_MFU_MIN`` (dormant unless set).
@@ -91,6 +98,7 @@ MIN_STEPS_ENV = "SPARKDL_TPU_ALERT_MIN_STEPS"
 MFU_MIN_ENV = "SPARKDL_TPU_ALERT_MFU_MIN"
 OVERLAP_MIN_ENV = "SPARKDL_TPU_ALERT_OVERLAP_MIN"
 QUEUE_GROWTH_ENV = "SPARKDL_TPU_ALERT_QUEUE_GROWTH"
+TTFT_P99_ENV = "SPARKDL_TPU_ALERT_TTFT_P99_S"
 HBM_FRAC_ENV = "SPARKDL_TPU_ALERT_HBM_FRAC"
 HEARTBEAT_GAP_FRAC_ENV = "SPARKDL_TPU_ALERT_HEARTBEAT_GAP_FRAC"
 
@@ -124,6 +132,8 @@ RULES = (
      "device HBM in use approaching the per-chip capacity budget"),
     ("queue_depth_growth", SEV_WARNING, "_check_queue_growth",
      "server_queue_depth growing faster than the configured rate"),
+    ("server_ttft", SEV_WARNING, "_check_server_ttft",
+     "fleet p99 time-to-first-token above the configured bound"),
     ("mfu_drop", SEV_WARNING, "_check_mfu",
      "live MFU gauge below the configured floor"),
     ("overlap_drop", SEV_WARNING, "_check_overlap",
@@ -154,6 +164,31 @@ def _median(xs):
         return None
     mid = n // 2
     return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def _histogram_quantile(buckets, counts, q):
+    """Upper-bound quantile estimate from cumulative-style histogram
+    buckets (``buckets`` are the finite upper bounds, ``counts`` the
+    per-bucket observation counts, one trailing overflow count
+    allowed). Returns the smallest bucket bound whose cumulative count
+    reaches ``q`` of the total — the standard Prometheus-style
+    conservative estimate — or None when the histogram is empty or
+    the quantile lands in the overflow bucket with no finite bound."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            if i < len(buckets):
+                return float(buckets[i])
+            # Overflow bucket: the best upper bound we have is "beyond
+            # the largest finite bucket" — report that bound so the
+            # rule still fires when the tail blew past every bucket.
+            return float(buckets[-1]) if buckets else None
+    return float(buckets[-1]) if buckets else None
 
 
 def maybe_make_engine(telemetry, detector=None, num_workers=None,
@@ -196,6 +231,7 @@ class AlertEngine:
         self.mfu_min = _env_float(env, MFU_MIN_ENV, None)
         self.overlap_min = _env_float(env, OVERLAP_MIN_ENV, None)
         self.queue_growth = _env_float(env, QUEUE_GROWTH_ENV, None)
+        self.ttft_p99_s = _env_float(env, TTFT_P99_ENV, None)
         # Baseline resolution order: explicit env seconds, committed
         # ledger record, self-calibration (the min rolling median the
         # run has shown, per rank).
@@ -212,6 +248,33 @@ class AlertEngine:
         self._records = []
         self._queue_samples = collections.deque(maxlen=256)
         self._next_check = 0.0
+
+    # -- elastic world changes -----------------------------------------------
+
+    def set_world(self, num_workers, detector=None):
+        """Rebind the engine to a resized gang (ISSUE 16): one engine
+        now spans attempts, and an elastic shrink/grow changes both
+        the rank universe and each rank's workload share. Always swap
+        in the new attempt's detector; on an actual world-size change,
+        drop the self-calibrated per-rank step-time baselines and the
+        per-rank alert latches for ranks that no longer exist —
+        rank k's data shard after a resize is a different rank k, so
+        its old healthy floor would fire false regressions (and a
+        departed rank's latch would suppress a future real one)."""
+        if detector is not None:
+            self._detector = detector
+        if num_workers is None or num_workers == self.num_workers:
+            return
+        self.num_workers = num_workers
+        # Self-calibrated baselines are per-(rank, shard); all stale.
+        # Explicit env / ledger baselines are world-independent and
+        # survive untouched (``_explicit_baseline`` is not cleared).
+        self._baselines.clear()
+        if self._baseline_source == "self":
+            self._baseline_source = None
+        for latch in [k for k in self._fired
+                      if isinstance(k[1], int) and k[1] >= num_workers]:
+            del self._fired[latch]
 
     # -- baseline ------------------------------------------------------------
 
@@ -267,6 +330,7 @@ class AlertEngine:
     def _build_context(self):
         events = self._telemetry.recent_events(self.window_s,
                                                now=self._wall())
+        events = self._drop_stale_ranks(events)
         # Execute-phase step durations per rank (seconds), window-
         # scoped — compile spans excluded exactly like observe.perf.
         step_durs = {}
@@ -290,7 +354,18 @@ class AlertEngine:
             pass
         live = self._detector.live_state() if self._detector else {}
         return {"events": events, "step_durs": step_durs,
-                "gauges": gauges, "live": live}
+                "gauges": gauges, "live": self._drop_stale_ranks(live)}
+
+    def _drop_stale_ranks(self, by_rank):
+        """Filter a rank-keyed mapping down to the CURRENT world: after
+        an elastic shrink the telemetry window still holds the departed
+        ranks' trailing events, and alerting on a rank that was
+        deliberately resized away is noise, not signal."""
+        world = self.num_workers
+        if world is None:
+            return by_rank
+        return {r: v for r, v in by_rank.items()
+                if not (isinstance(r, int) and r >= world)}
 
     # -- rule evaluators -----------------------------------------------------
 
@@ -410,6 +485,44 @@ class AlertEngine:
                 "window_s": round(span, 1),
             })]
         return []
+
+    def _check_server_ttft(self, ctx):
+        # Fleet-level SLO, not a rank-level one: every FleetFrontend
+        # registered in-process with the statusz module exports a
+        # server_ttft_seconds histogram; estimate p99 from its buckets
+        # (conservative upper bound) and fire once per fleet index
+        # when the bound is configured and exceeded.
+        if self.ttft_p99_s is None:
+            return []
+        try:
+            from sparkdl_tpu.observe.statusz import live_fleets
+        except Exception:
+            return []
+        out = []
+        for idx, fleet in enumerate(live_fleets() or ()):
+            metrics = getattr(fleet, "metrics", None)
+            if metrics is None:
+                continue
+            try:
+                snap = metrics.snapshot()
+            except Exception:
+                continue
+            for h in snap.get("histograms", ()):
+                if h.get("name") != "server_ttft_seconds":
+                    continue
+                count = h.get("count") or sum(h.get("counts") or ())
+                if count < self.min_steps:
+                    continue
+                p99 = _histogram_quantile(
+                    h.get("buckets") or (), h.get("counts") or (), 0.99)
+                if p99 is not None and p99 > self.ttft_p99_s:
+                    out.append((f"fleet{idx}", {
+                        "fleet": idx,
+                        "ttft_p99_s": round(p99, 6),
+                        "threshold_s": self.ttft_p99_s,
+                        "requests": count,
+                    }))
+        return out
 
     def _check_mfu(self, ctx):
         if self.mfu_min is None:

@@ -255,6 +255,27 @@ def _diagnose_alerts(run_dir):
     }
 
 
+def _diagnose_elastic(run_dir):
+    """Elastic-controller section (or None when the run predates
+    autonomous elasticity / never enabled it): the ``elastic.json``
+    decision log — every grow/yield/reclaim the controller planned,
+    emitted, refused or cancelled, reproduced from the artifact
+    alone."""
+    doc = _load_json(os.path.join(run_dir, "elastic.json"))
+    if not isinstance(doc, dict):
+        return None
+    decisions = [d for d in doc.get("decisions", ())
+                 if isinstance(d, dict)]
+    return {
+        "enabled": bool(doc.get("enabled")),
+        "arbiter": bool(doc.get("arbiter")),
+        "current_np": doc.get("current_np"),
+        "available_np": doc.get("available_np"),
+        "transitions": doc.get("transitions") or {},
+        "decisions": decisions,
+    }
+
+
 def _diagnose_serving(events, by_rank, top_n=5):
     """Serving-run section (or None for pure gang dirs): slowest
     requests by TTFT, the admission rejection/deferral breakdown, and
@@ -447,6 +468,7 @@ def diagnose(run_dir):
         "flight_recorder_recovered_events": len(ring_fresh),
         "serving": _diagnose_serving(events, by_rank),
         "alerts": _diagnose_alerts(run_dir),
+        "elastic": _diagnose_elastic(run_dir),
         "perf": _diagnose_perf(run_dir, events, by_rank),
         "comms": _diagnose_comms(run_dir, by_rank),
         "fixit": fixit,
@@ -552,6 +574,21 @@ def render_text(diag):
             lines.append(f"alerts: {len(fired)} fired")
             for a in fired:
                 lines.append("  " + format_alert_line(a))
+    elastic = diag.get("elastic")
+    if elastic and elastic.get("enabled"):
+        decisions = elastic.get("decisions") or []
+        head = (f"elastic: {len(decisions)} decision(s)"
+                if decisions else "elastic: enabled, no decisions")
+        if elastic.get("arbiter"):
+            head += " (arbiter on)"
+        lines.append(head)
+        for d in decisions:
+            line = (f"  [{d.get('direction')}] np {d.get('from_np')} "
+                    f"-> {d.get('to_np')} ({d.get('reason')}): "
+                    f"{d.get('outcome')}")
+            if d.get("resume_step") is not None:
+                line += f" from step {d['resume_step']}"
+            lines.append(line)
     perf = diag.get("perf")
     if perf:
         lines.append("where the time went (per step-thread second):")

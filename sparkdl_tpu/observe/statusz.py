@@ -122,6 +122,24 @@ def fleet_status():
     return out or None
 
 
+def live_fleets():
+    """The live registered :class:`FleetFrontend` OBJECTS (not status
+    rows) — what the elastic chip-budget arbiter scales and the
+    ``server_ttft`` alert rule reads histograms from. Prunes dead
+    refs like :func:`fleet_status`; returns a (possibly empty) list."""
+    out = []
+    with _fleets_lock:
+        live = []
+        for ref in _fleets:
+            fleet = ref()
+            if fleet is None:
+                continue
+            live.append(ref)
+            out.append(fleet)
+        _fleets[:] = live
+    return out
+
+
 def _reset_fleets_for_tests():
     with _fleets_lock:
         _fleets.clear()
@@ -146,7 +164,7 @@ def statusz_port(env=None):
 
 
 def maybe_start_statusz(telemetry, detector=None, num_workers=None,
-                        alerts=None, env=None):
+                        alerts=None, elastic=None, env=None):
     """The latch: a running :class:`StatuszServer` when
     ``SPARKDL_TPU_STATUSZ_PORT`` is set and telemetry is live, None
     otherwise — no thread, no socket, no allocation on the default
@@ -159,7 +177,7 @@ def maybe_start_statusz(telemetry, detector=None, num_workers=None,
     try:
         return StatuszServer(
             telemetry, detector=detector, num_workers=num_workers,
-            alerts=alerts, port=port, env=env,
+            alerts=alerts, elastic=elastic, port=port, env=env,
         ).start()
     except OSError as e:
         import logging
@@ -176,11 +194,13 @@ class StatuszServer:
     idempotent and joins the serve thread."""
 
     def __init__(self, telemetry, detector=None, num_workers=None,
-                 alerts=None, host="127.0.0.1", port=0, env=None):
+                 alerts=None, elastic=None, host="127.0.0.1", port=0,
+                 env=None):
         env = os.environ if env is None else env
         self._telemetry = telemetry
         self._detector = detector
         self._alerts = alerts
+        self._elastic = elastic
         self.num_workers = num_workers
         self._t0 = time.time()
         self._closed = threading.Event()
@@ -282,6 +302,11 @@ class StatuszServer:
         fleet = fleet_status()
         if fleet is not None:
             doc["fleet"] = fleet
+        if self._elastic is not None:
+            try:
+                doc["elastic"] = self._elastic.status()
+            except Exception:
+                pass
         return doc
 
     def _serve_statusz(self, handler):
@@ -298,11 +323,15 @@ class StatuszServer:
         in mission control (current attempt's world vs the previous
         attempt's)."""
         from sparkdl_tpu import observe
-        from sparkdl_tpu.horovod.supervisor import attempt_world_sizes
+        from sparkdl_tpu.horovod.supervisor import (
+            attempt_chip_hours,
+            attempt_world_sizes,
+        )
 
         reg = observe.metrics()
         worlds = attempt_world_sizes()
-        return {
+        chip_hours = attempt_chip_hours()
+        out = {
             "attempts_total": reg.counter("gang_attempts_total").value,
             "restarts_total": reg.counter("gang_restarts_total").value,
             "world_size": worlds[-1] if worlds else self.num_workers,
@@ -310,6 +339,13 @@ class StatuszServer:
                 worlds[-2] if len(worlds) > 1 else None,
             "world_sizes": worlds,
         }
+        if chip_hours:
+            out["chip_hours"] = chip_hours
+            known = [e["chip_hours"] for e in chip_hours
+                     if e.get("chip_hours") is not None]
+            if known:
+                out["chip_hours_total"] = round(sum(known), 6)
+        return out
 
     def _perf_window(self):
         """Rolling attribution over the journal window, per rank:
@@ -388,6 +424,6 @@ class StatuszServer:
 
 __all__ = [
     "StatuszServer", "maybe_start_statusz", "statusz_port",
-    "register_fleet", "fleet_status", "STATUSZ_PORT_ENV",
-    "STATUSZ_SCHEMA",
+    "register_fleet", "fleet_status", "live_fleets",
+    "STATUSZ_PORT_ENV", "STATUSZ_SCHEMA",
 ]

@@ -186,6 +186,8 @@ def _build():
           "serve smoke p99 TTFT bound override"),
         k("SPARKDL_TPU_SERVE_SMOKE_INTER_TOKEN_P99_S", "float", None,
           "bench", "serve smoke p99 inter-token bound override"),
+        k("SPARKDL_TPU_COLOCATION_TTFT_P99_S", "float", None, "bench",
+          "colocation smoke client p99 TTFT bound override"),
 
         # -- gang wiring (launcher/worker contract) -----------------
         k("SPARKDL_TPU_RANK", "int", None, "gang", "worker rank"),
@@ -269,6 +271,51 @@ def _build():
           "params per group; 0 = auto (group only when the restore "
           "high-water approaches the HBM budget)"),
 
+        # -- autonomous elasticity (ISSUE 16) -----------------------
+        k("SPARKDL_TPU_ELASTIC", "bool", "0", "supervisor",
+          "enable the capacity-watching elastic controller: grow the "
+          "gang back autonomously when chips return (unset = no "
+          "object, no probe, no thread)"),
+        k("SPARKDL_TPU_ELASTIC_PROBE", "enum", "auto", "supervisor",
+          "capacity probe: auto | env | file | devices (/dev/accel* "
+          "count) | slots (local slot table)"),
+        k("SPARKDL_TPU_ELASTIC_CAPACITY", "int", None, "supervisor",
+          "capacity override in chips (tests/chaos; wins in auto "
+          "probe order)"),
+        k("SPARKDL_TPU_ELASTIC_CAPACITY_FILE", "path", None,
+          "supervisor", "file re-read every poll whose content is the "
+          "chip capacity (chaos harnesses flip it mid-run)"),
+        k("SPARKDL_TPU_ELASTIC_CHECK_S", "float", "2.0", "supervisor",
+          "capacity poll cadence (s)"),
+        k("SPARKDL_TPU_ELASTIC_DEBOUNCE_S", "float", "10",
+          "supervisor", "surplus capacity must hold this long before "
+          "a grow is planned (flap guard — never thrash shrink/grow)",
+          tunable=True, trial_values=(5, 10, 30)),
+        k("SPARKDL_TPU_ELASTIC_MARGIN", "float", "0.8", "supervisor",
+          "ledger gate: a measured candidate np must retain at least "
+          "this fraction of the current per-chip throughput or the "
+          "grow is refused as unprofitable",
+          tunable=True, trial_values=(0.7, 0.8, 0.9)),
+        k("SPARKDL_TPU_ELASTIC_CKPT_WAIT_S", "float", "60",
+          "supervisor", "max wait for a step boundary (committed "
+          "checkpoint) after a resize decision before falling back "
+          "to the newest committed step (none at all = cancel)"),
+        k("SPARKDL_TPU_ELASTIC_MAX_NP", "int", None, "supervisor",
+          "hard cap on the elastic grow target"),
+        k("SPARKDL_TPU_ELASTIC_MIN_NP", "int", "1", "supervisor",
+          "floor the arbiter may not shrink training below"),
+        k("SPARKDL_TPU_ELASTIC_ARBITER", "bool", "0", "supervisor",
+          "enable the train/serve chip-budget arbiter: serving "
+          "alerts demand chips, training yields and reclaims"),
+        k("SPARKDL_TPU_ELASTIC_ARBITER_RULES", "list",
+          "queue_depth_growth,server_ttft", "supervisor",
+          "alert rules whose firings count as serving chip demand"),
+        k("SPARKDL_TPU_ELASTIC_ARBITER_CHIPS", "int", "1",
+          "supervisor", "chips yielded per arbiter demand"),
+        k("SPARKDL_TPU_ELASTIC_ARBITER_CLEAR_S", "float", "30",
+          "supervisor", "quiet period (no demand, drained fleet "
+          "queue) before training reclaims yielded chips"),
+
         # -- static analysis pre-flight -----------------------------
         k("SPARKDL_TPU_PREFLIGHT_LINT", "bool", "0", "analysis",
           "launcher pre-flight: lint payload + registered steps, "
@@ -331,6 +378,10 @@ def _build():
         k("SPARKDL_TPU_ALERT_HEARTBEAT_GAP_FRAC", "float", "0.5",
           "observe", "heartbeat_gap warns at this fraction of the "
           "stall window"),
+        k("SPARKDL_TPU_ALERT_TTFT_P99_S", "float", None, "observe",
+          "server_ttft alert bound: fleet p99 time-to-first-token "
+          "seconds, estimated from histogram buckets (dormant unless "
+          "set)"),
 
         # -- compile cache ------------------------------------------
         k("SPARKDL_TPU_COMPILE_CACHE_DIR", "path", None, "compile",

@@ -1,0 +1,314 @@
+"""Autonomous elasticity policy in isolation (ISSUE 16): the capacity
+probe's override semantics, the ledger-driven np selection (grow /
+stay / refuse fixtures), the typed no-checkpoint refusal, and the
+controller's debounce against a flapping probe — all jax-free, all
+driver-side, all inside the tier-1 gate.
+
+The gang-level proof (kill -> shrink -> autonomous grow with real
+worker processes) lives in tests/horovod/test_elastic_resume.py and
+ci/elastic_smoke.py; this file pins the DECISIONS."""
+
+import json
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.horovod import elastic
+from sparkdl_tpu.horovod.elastic import (
+    ElasticController,
+    ElasticGrowRefused,
+    check_grow,
+    choose_np,
+    maybe_make_controller,
+    probe_capacity,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_observe():
+    observe._reset_for_tests()
+    elastic._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+    elastic._reset_for_tests()
+
+
+def _ledger(*entries):
+    """Ledger fixtures: (np, steps_per_s) -> a history record shaped
+    like perf.history_record output (metrics + top-level extra)."""
+    return [
+        {"np": np_v, "bench": "fixture",
+         "metrics": {"steps_per_s": {"value": rate}}}
+        for np_v, rate in entries
+    ]
+
+
+# -- probe --------------------------------------------------------------------
+
+
+def test_probe_env_override_wins():
+    env = {"SPARKDL_TPU_ELASTIC_CAPACITY": "4"}
+    assert probe_capacity(env) == 4
+
+
+def test_probe_env_unparsable_is_unknown_not_fallthrough(tmp_path):
+    cap = tmp_path / "cap"
+    cap.write_text("8")
+    env = {
+        "SPARKDL_TPU_ELASTIC_CAPACITY": "banana",
+        "SPARKDL_TPU_ELASTIC_CAPACITY_FILE": str(cap),
+    }
+    # a configured-but-broken override must report UNKNOWN, never the
+    # next source's number
+    assert probe_capacity(env) is None
+
+
+def test_probe_file_reread_every_call(tmp_path):
+    cap = tmp_path / "cap"
+    cap.write_text("1")
+    env = {"SPARKDL_TPU_ELASTIC_PROBE": "file",
+           "SPARKDL_TPU_ELASTIC_CAPACITY_FILE": str(cap)}
+    assert probe_capacity(env) == 1
+    cap.write_text("2")
+    assert probe_capacity(env) == 2
+
+
+def test_probe_file_missing_is_unknown(tmp_path):
+    env = {"SPARKDL_TPU_ELASTIC_PROBE": "file",
+           "SPARKDL_TPU_ELASTIC_CAPACITY_FILE":
+               str(tmp_path / "never")}
+    assert probe_capacity(env) is None
+
+
+# -- choose_np: grow / stay / refuse ------------------------------------------
+
+
+def test_choose_np_stays_without_surplus():
+    assert choose_np(2, 2, history=[]) == 2
+    assert choose_np(2, 1, history=[]) == 2
+
+
+def test_choose_np_grows_with_empty_ledger():
+    # nothing provable -> grow to the full surplus
+    assert choose_np(1, 4, history=[]) == 4
+
+
+def test_choose_np_grows_when_ledger_blesses_target():
+    history = _ledger((1, 10.0), (2, 19.0))   # 9.5/chip vs 10/chip
+    assert choose_np(1, 2, history, margin=0.8) == 2
+
+
+def test_choose_np_refuses_provably_worse_config():
+    history = _ledger((1, 10.0), (2, 10.0))   # 5/chip: halves per-chip
+    with pytest.raises(ElasticGrowRefused) as ei:
+        choose_np(1, 2, history, margin=0.8)
+    assert ei.value.reason == "unprofitable"
+    assert ei.value.findings    # names the rejected candidate
+
+
+def test_choose_np_falls_back_to_smaller_blessed_candidate():
+    # np=4 is proven bad, np=3 unmeasured -> 3 (nothing provable)
+    history = _ledger((2, 20.0), (4, 10.0))
+    assert choose_np(2, 4, history, margin=0.8) == 3
+
+
+def test_choose_np_median_discipline():
+    # three samples at np=2: the MEDIAN (19.0 -> 9.5/chip) passes the
+    # 0.8 margin even though the worst sample alone would not
+    history = (_ledger((1, 10.0))
+               + _ledger((2, 7.0), (2, 19.0), (2, 20.0)))
+    assert choose_np(1, 2, history, margin=0.8) == 2
+
+
+def test_choose_np_respects_max_np_cap():
+    assert choose_np(1, 8, history=[], max_np=2) == 2
+
+
+def test_choose_np_reads_history_env(tmp_path, monkeypatch):
+    hist = tmp_path / "history.jsonl"
+    with open(hist, "w") as f:
+        for rec in _ledger((1, 10.0), (2, 10.0)):
+            f.write(json.dumps(rec) + "\n")
+    monkeypatch.setenv("SPARKDL_TPU_PERF_HISTORY", str(hist))
+    with pytest.raises(ElasticGrowRefused):
+        choose_np(1, 2, margin=0.8)
+
+
+# -- check_grow: the feasibility gate -----------------------------------------
+
+
+def test_check_grow_refuses_without_resume_dir():
+    with pytest.raises(ElasticGrowRefused) as ei:
+        check_grow(1, 2, resume_dir=None, history=[])
+    assert ei.value.reason == "no_checkpoint"
+
+
+def test_check_grow_refuses_without_committed_step(tmp_path):
+    with pytest.raises(ElasticGrowRefused) as ei:
+        check_grow(1, 2, resume_dir=str(tmp_path),
+                   latest_step=lambda: None, history=[])
+    assert ei.value.reason == "no_checkpoint"
+
+
+def test_check_grow_returns_target(tmp_path):
+    assert check_grow(1, 2, resume_dir=str(tmp_path),
+                      latest_step=lambda: 7, history=[]) == 2
+
+
+# -- the controller: latch, debounce, flap, clamp -----------------------------
+
+
+def test_maybe_make_controller_is_latched():
+    assert maybe_make_controller(env={}) is None
+    assert maybe_make_controller(
+        env={"SPARKDL_TPU_ELASTIC": "0"}) is None
+    ctrl = maybe_make_controller(
+        2, env={"SPARKDL_TPU_ELASTIC": "1"})
+    assert isinstance(ctrl, ElasticController)
+
+
+@pytest.fixture(autouse=True)
+def _empty_ledger(monkeypatch, tmp_path):
+    """The controller's check_grow consults read_history() via the
+    process env — point it at an empty ledger so the repo's real
+    history.jsonl can never change a policy verdict here."""
+    monkeypatch.setenv("SPARKDL_TPU_PERF_HISTORY",
+                       str(tmp_path / "no-history.jsonl"))
+
+
+def _controller(caps, steps, **env):
+    """A controller on a fake clock and a scripted probe: caps is the
+    sequence of capacities successive polls observe (the last value
+    repeats); steps() supplies the committed checkpoint step."""
+    seq = list(caps)
+
+    def probe():
+        return seq.pop(0) if len(seq) > 1 else seq[0]
+
+    env = {"SPARKDL_TPU_ELASTIC": "1",
+           "SPARKDL_TPU_ELASTIC_CHECK_S": "1",
+           "SPARKDL_TPU_ELASTIC_DEBOUNCE_S": "3",
+           **env}
+    return ElasticController(
+        2, env=env, probe=probe, clock=lambda: 0.0,
+        latest_step=steps, resume_dir="/tmp/ck-elastic-policy")
+
+
+def test_flapping_probe_never_thrashes():
+    """Chaos flap: capacity blinks 3,2,3,2,... — the surplus never
+    holds the debounce window, so the controller must plan NOTHING
+    (and in particular never emit a shrink: capacity loss alone is
+    not a preemption)."""
+    step = {"v": 5}
+    ctrl = _controller([3, 2, 3, 2, 3, 2, 3, 2, 3, 2],
+                       lambda: step["v"])
+    for t in range(10):
+        step["v"] += 1
+        assert ctrl.poll(now=float(t)) is None
+    assert ctrl._pending is None
+    assert ctrl._decisions == []
+    assert ctrl.current_np == 2
+
+
+def test_debounced_grow_emits_at_checkpoint_boundary():
+    step = {"v": 5}
+    ctrl = _controller([4], lambda: step["v"])
+    assert ctrl.poll(now=0.0) is None    # surplus noticed
+    assert ctrl.poll(now=1.0) is None    # debouncing
+    assert ctrl.poll(now=2.0) is None
+    assert ctrl.poll(now=3.0) is None    # planned (ckpt not advanced)
+    assert ctrl._pending is not None
+    assert ctrl._pending["direction"] == "grow"
+    step["v"] = 6                        # the next step commits
+    req = ctrl.poll(now=4.0)
+    assert req == {"direction": "grow", "target_np": 4,
+                   "reason": "capacity_returned", "resume_step": 6}
+    # the emitted plan answers the supervisor's what-np-next question
+    assert ctrl.relaunch_target() == 4
+
+
+def test_grow_refused_is_latched_until_capacity_changes(monkeypatch):
+    consults = {"n": 0}
+
+    def fake_check(cur, cap, **kw):
+        consults["n"] += 1
+        raise ElasticGrowRefused(
+            "every candidate slower per chip",
+            findings=[f"np={cap}: slower"], reason="unprofitable")
+
+    monkeypatch.setattr(elastic, "check_grow", fake_check)
+    ctrl = _controller([3], lambda: 5,
+                       SPARKDL_TPU_ELASTIC_DEBOUNCE_S="0")
+    assert ctrl.poll(now=0.0) is None   # surplus noticed
+    assert ctrl.poll(now=1.0) is None   # consulted -> refused + latched
+    refused = [d for d in ctrl._decisions
+               if d["outcome"] == "refused"]
+    assert len(refused) == 1
+    assert refused[0]["reason"] == "unprofitable"
+    # the same capacity never re-consults the ledger mid-run
+    assert ctrl.poll(now=2.0) is None
+    assert ctrl.poll(now=3.0) is None
+    assert consults["n"] == 1
+
+
+def test_relaunch_target_clamps_to_capacity(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPARKDL_TPU_TELEMETRY_DIR", str(tmp_path))
+    observe._reset_for_tests()
+    ctrl = _controller([1], lambda: 5)
+    assert ctrl.relaunch_target() == 1      # 2 chips gone -> clamp
+    ctrl.note_attempt(1)
+    assert ctrl.current_np == 1
+    # the clamp landed as a typed shrink transition
+    assert ctrl._transitions == {"shrink:capacity": 1}
+    reg = observe.metrics()
+    assert reg.counter("gang_elastic_transitions_total",
+                       direction="shrink", reason="capacity").value == 1
+
+
+def test_note_attempt_consumes_emitted_plan():
+    step = {"v": 5}
+    ctrl = _controller([4], lambda: step["v"],
+                       SPARKDL_TPU_ELASTIC_DEBOUNCE_S="0")
+    assert ctrl.poll(now=0.0) is None
+    assert ctrl.poll(now=1.0) is None     # planned
+    step["v"] = 6
+    assert ctrl.poll(now=2.0) is not None  # emitted
+    ctrl.note_attempt(4)
+    assert ctrl._transitions == {"grow:capacity_returned": 1}
+    assert ctrl._pending is None
+    # the decision log carries the emitted resize AND the transition
+    outcomes = [d["outcome"] for d in ctrl._decisions]
+    assert "resize" in outcomes and "transition" in outcomes
+
+
+def test_ckpt_wait_expiry_with_vanished_checkpoint_cancels():
+    """A plan ripens only at a checkpoint boundary; if the committed
+    step vanishes and the bounded wait expires, the plan is cancelled
+    with the typed no_checkpoint reason — never emitted."""
+    step = {"v": 5}
+    ctrl = _controller([4], lambda: step["v"],
+                       SPARKDL_TPU_ELASTIC_DEBOUNCE_S="0",
+                       SPARKDL_TPU_ELASTIC_CKPT_WAIT_S="5")
+    assert ctrl.poll(now=0.0) is None
+    assert ctrl.poll(now=1.0) is None     # planned at t=1 (step 5)
+    assert ctrl._pending is not None
+    step["v"] = None                      # checkpoint dir wiped
+    assert ctrl.poll(now=3.0) is None     # still waiting
+    assert ctrl.poll(now=7.0) is None     # wait expired -> cancelled
+    assert ctrl._pending is None
+    cancelled = [d for d in ctrl._decisions
+                 if d["outcome"] == "cancelled"]
+    assert cancelled and cancelled[0]["reason"] == "no_checkpoint"
+
+
+def test_status_reports_current_vs_available():
+    ctrl = _controller([4], lambda: 5)
+    ctrl.poll(now=0.0)
+    doc = ctrl.status()
+    assert doc["current_np"] == 2
+    assert doc["available_np"] == 4
+    assert doc["enabled"] is True
+    assert doc["pending"] is None
+    rep = ctrl.report()
+    assert rep["schema"] == elastic.ELASTIC_SCHEMA
+    assert rep["decisions"] == []

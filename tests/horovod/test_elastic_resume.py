@@ -359,14 +359,18 @@ def test_supervisor_ships_reshard_axes_from_sidecar(tmp_path,
 # -- the chaos acceptance: kill -> shrink -> train -> grow ------------------
 
 
-def _elastic_train_main(ckpt_dir, total_steps):
+def _elastic_train_main(ckpt_dir, total_steps, step_s=0.0):
     """Deterministic GSPMD training loop whose state is sharded over
     the gang mesh ({"data": world}) and checkpointed every step. The
     update depends on the step only, so the trajectory is identical at
     any world size — what makes bit-exact-modulo-resharding a
     meaningful assertion. Resumable three ways: supervisor restart
     context (with target axes), or a fresh run against an existing
-    checkpoint dir (the grow-back leg), or from scratch."""
+    checkpoint dir (the grow-back leg), or from scratch. ``step_s``
+    paces the loop in wall time so the driver-side capacity watcher
+    has room to act mid-run (the autonomous-grow test)."""
+    import time as _time
+
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -415,6 +419,8 @@ def _elastic_train_main(ckpt_dir, total_steps):
             hvd.barrier()   # rank 0's save durable before any death
             history[str(step)] = full_host_value(w).tolist()
             chaos_step(step)
+            if step_s:
+                _time.sleep(step_s)
     finally:
         ckpt.close()
     return {
@@ -496,3 +502,86 @@ def test_kill_shrink_train_grow_matches_control(monkeypatch, tmp_path):
     assert grown["restored_w"] == shrunken["w"]
     # ... and the full round trip matches the never-killed control
     assert grown["w"] == control["w"]
+
+
+@pytest.mark.gang
+@pytest.mark.slow
+def test_kill_shrink_autonomous_grow_matches_control(monkeypatch,
+                                                     tmp_path):
+    """The ISSUE 16 acceptance: the same elastic round trip with NO
+    operator step — no ``SPARKDL_TPU_GANG_RELAUNCH_NP``, no second
+    run. The capacity watcher clamps the post-kill relaunch to the
+    surviving chip, notices capacity return mid-run, and recycles the
+    gang back to np=2 through the reshard/restore path — all inside
+    ONE supervised launch, final params matching the never-killed
+    control."""
+    import threading
+    import time
+
+    total = 12
+
+    control = HorovodRunner(np=-2).run(
+        _elastic_train_main, ckpt_dir=str(tmp_path / "control"),
+        total_steps=total)
+    assert control["attempt"] == 0 and control["world"] == 2
+
+    cap_file = tmp_path / "capacity"
+    cap_file.write_text("1")          # only 1 chip until we give it back
+    ck = tmp_path / "ck"
+    # the whole point: nobody sets the manual relaunch knob
+    assert "SPARKDL_TPU_GANG_RELAUNCH_NP" not in os.environ
+    monkeypatch.setenv("SPARKDL_TPU_GANG_MAX_RETRIES", "2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_BASE", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_BACKOFF_MAX", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_GANG_RESUME_DIR", str(ck))
+    monkeypatch.setenv("SPARKDL_TPU_ABORT_GRACE", "5")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_KILL_RANK", "1")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_KILL_STEP", "2")
+    monkeypatch.setenv("SPARKDL_TPU_CHAOS_ONCE_FILE",
+                       str(tmp_path / "one-kill"))
+    monkeypatch.setenv("SPARKDL_TPU_ELASTIC", "1")
+    monkeypatch.setenv("SPARKDL_TPU_ELASTIC_PROBE", "file")
+    monkeypatch.setenv("SPARKDL_TPU_ELASTIC_CAPACITY_FILE",
+                       str(cap_file))
+    monkeypatch.setenv("SPARKDL_TPU_ELASTIC_CHECK_S", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_ELASTIC_DEBOUNCE_S", "0.4")
+    monkeypatch.setenv("SPARKDL_TPU_ELASTIC_CKPT_WAIT_S", "60")
+    # empty ledger: nothing provable, the grow is unconditional
+    monkeypatch.setenv("SPARKDL_TPU_PERF_HISTORY",
+                       str(tmp_path / "no-history.jsonl"))
+
+    stop = threading.Event()
+
+    def _return_capacity():
+        # the chips come back only after the SHRUNKEN gang has proven
+        # progress (a committed step past the kill point)
+        while not stop.is_set():
+            if (latest_complete_step(str(ck)) or -1) >= 3:
+                cap_file.write_text("2")
+                return
+            time.sleep(0.05)
+
+    returner = threading.Thread(target=_return_capacity, daemon=True)
+    returner.start()
+    try:
+        result = HorovodRunner(np=-2).run(
+            _elastic_train_main, ckpt_dir=str(ck),
+            total_steps=total, step_s=0.45)
+    finally:
+        stop.set()
+        returner.join(timeout=5)
+
+    assert (tmp_path / "one-kill").exists()   # the kill really fired
+    assert result["attempt"] == 2     # kill relaunch + elastic resize
+    assert result["world"] == 2       # grew back, zero operator steps
+    assert result["axes"]["data"] == 2
+    reshard = result["reshard"]
+    assert reshard is not None and reshard["direction"] == "grow"
+    assert reshard["source_axes"]["data"] == 1
+    assert reshard["target_axes"]["data"] == 2
+    # the resize resumed from a step the shrunken gang committed
+    resume = result["resume_step"]
+    assert resume is not None and resume > 2
+    assert result["restored_w"] == control["history"][str(resume)]
+    # ...and the autonomous round trip lands on the control's params
+    assert result["w"] == control["w"]

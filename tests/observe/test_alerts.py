@@ -329,6 +329,138 @@ def test_firing_emits_instant_and_counter(monkeypatch, tmp_path):
         severity="critical").value == 1
 
 
+# -- elastic resize: one engine spans attempts (ISSUE 16) ---------------------
+
+
+def test_set_world_rebuilds_rank_state_on_resize():
+    """An elastic shrink changes each rank's data shard: the engine's
+    self-calibrated baselines are all stale, departed ranks' trailing
+    window events must go quiet, and a departed rank's once-per-launch
+    latch must not suppress a future real firing after a grow-back."""
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, num_workers=2, env=ENV)
+    now = time.time()
+    gt.ingest(0, _payload(100, events=_steps(now - 10,
+                                             [0.01, 0.011, 0.009])))
+    gt.ingest(1, _payload(101, events=_steps(now - 10,
+                                             [0.01, 0.011, 0.009])))
+    assert engine.poll() == []
+    assert engine.baseline_for(0) is not None
+    assert engine.baseline_for(1) is not None
+    gt.ingest(1, _payload(101, events=_steps(now - 5, [0.05] * 6)))
+    (rec,) = engine.poll()
+    assert rec["rank"] == 1
+    assert ("step_time_regression", 1) in engine._fired
+
+    engine.set_world(1)
+    # every self-calibrated baseline is per-(rank, shard): all stale
+    assert engine.baseline_for(0) is None
+    assert engine.baseline_for(1) is None
+    # rank 1's slow events still sit in the telemetry window, but the
+    # engine never judges a deliberately resized-away rank
+    assert all(r["rank"] != 1 for r in engine.poll())
+    # the departed rank's latch is gone; rank 0's record survives in
+    # the launch history
+    assert ("step_time_regression", 1) not in engine._fired
+    assert len(engine.records()) == 1
+
+
+def test_set_world_same_size_keeps_state_swaps_detector():
+    gt = GangTelemetry()
+    engine = AlertEngine(gt, num_workers=2, env=ENV)
+    now = time.time()
+    gt.ingest(0, _payload(100, events=_steps(now - 10,
+                                             [0.01, 0.011, 0.009])))
+    assert engine.poll() == []
+    base = engine.baseline_for(0)
+    det = _FakeDetector(stall_s=100, live={})
+    engine.set_world(2, detector=det)       # same world: a plain retry
+    assert engine.baseline_for(0) == base   # calibration survives
+    assert engine._detector is det          # detector always rebinds
+
+
+def test_set_world_keeps_explicit_baseline():
+    env = dict(ENV, SPARKDL_TPU_ALERT_STEP_BASELINE_S="0.02")
+    engine = AlertEngine(GangTelemetry(), num_workers=2, env=env)
+    engine.set_world(4)
+    # env/ledger baselines are world-independent
+    assert engine.baseline_for(0) == pytest.approx(0.02)
+
+
+# -- server_ttft: the fleet p99 SLO rule (ISSUE 16) ---------------------------
+
+
+def test_histogram_quantile_upper_bound():
+    from sparkdl_tpu.observe.alerts import _histogram_quantile
+
+    buckets = [0.01, 0.1, 1.0]
+    # 90 fast, 9 medium, 1 slow (in the +Inf bucket)
+    assert _histogram_quantile(buckets, [90, 9, 0, 1], 0.5) == 0.01
+    assert _histogram_quantile(buckets, [90, 9, 0, 1], 0.99) == 0.1
+    assert _histogram_quantile(buckets, [90, 9, 0, 1], 1.0) == 1.0
+    assert _histogram_quantile(buckets, [0, 0, 0, 0], 0.99) is None
+
+
+def test_server_ttft_dormant_without_threshold():
+    engine = AlertEngine(GangTelemetry(), env=ENV)
+    assert engine._check_server_ttft({}) == []
+
+
+def test_server_ttft_fires_on_registered_fleet():
+    """The colocation demand signal: a FleetFrontend registered with
+    statusz exports server_ttft_seconds; the rule estimates p99 from
+    its buckets and fires once per fleet when the bound is crossed."""
+    import importlib
+
+    statusz_mod = importlib.import_module(
+        "sparkdl_tpu.observe.statusz")
+    statusz_mod._reset_fleets_for_tests()
+
+    class FakeFleet:
+        metrics = Registry()
+
+    fleet = FakeFleet()
+    for _ in range(10):
+        fleet.metrics.histogram("server_ttft_seconds").observe(0.2)
+    statusz_mod.register_fleet(fleet)
+    try:
+        env = dict(ENV, SPARKDL_TPU_ALERT_TTFT_P99_S="0.05")
+        engine = AlertEngine(GangTelemetry(), env=env)
+        (rec,) = engine.poll()
+        assert rec["rule"] == "server_ttft"
+        assert rec["severity"] == "warning"
+        assert rec["rank"] is None          # a fleet SLO, not a rank
+        assert rec["detail"]["fleet"] == 0
+        assert rec["detail"]["ttft_p99_s"] > 0.05
+        assert rec["detail"]["requests"] == 10
+        # latched per fleet index
+        assert engine.poll() == []
+    finally:
+        statusz_mod._reset_fleets_for_tests()
+
+
+def test_server_ttft_under_bound_is_quiet():
+    import importlib
+
+    statusz_mod = importlib.import_module(
+        "sparkdl_tpu.observe.statusz")
+    statusz_mod._reset_fleets_for_tests()
+
+    class FakeFleet:
+        metrics = Registry()
+
+    fleet = FakeFleet()
+    for _ in range(10):
+        fleet.metrics.histogram("server_ttft_seconds").observe(0.001)
+    statusz_mod.register_fleet(fleet)
+    try:
+        env = dict(ENV, SPARKDL_TPU_ALERT_TTFT_P99_S="0.5")
+        engine = AlertEngine(GangTelemetry(), env=env)
+        assert engine.poll() == []
+    finally:
+        statusz_mod._reset_fleets_for_tests()
+
+
 # -- acceptance: the injected-slowdown gang ----------------------------------
 
 

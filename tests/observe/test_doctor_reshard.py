@@ -60,3 +60,42 @@ def test_doctor_without_reshard_has_no_section(tmp_path):
     diag = doctor.diagnose(run_dir)
     assert diag["reshards"] == []
     assert "reshard:" not in doctor.render_text(diag)
+
+
+def test_doctor_renders_elastic_decision_log(tmp_path):
+    """ISSUE 16: the run dir's elastic.json decision log renders as an
+    elastic section — direction, np transition, reason, outcome, the
+    resume step — so every autonomous grow/yield/reclaim is auditable
+    from artifacts alone."""
+    run_dir = _run_dir(tmp_path, [])
+    with open(os.path.join(run_dir, "elastic.json"), "w") as f:
+        json.dump({
+            "schema": "sparkdl_tpu.horovod.elastic/1",
+            "enabled": True, "arbiter": True,
+            "current_np": 2, "available_np": 2,
+            "transitions": {"grow:capacity_returned": 1},
+            "decisions": [
+                {"direction": "grow", "outcome": "resize",
+                 "reason": "capacity_returned", "from_np": 1,
+                 "to_np": 2, "resume_step": 6, "ts": 1.0},
+                {"direction": "grow", "outcome": "refused",
+                 "reason": "unprofitable", "from_np": 2,
+                 "to_np": 4, "ts": 2.0},
+            ],
+        }, f)
+    diag = doctor.diagnose(run_dir)
+    el = diag["elastic"]
+    assert el["enabled"] is True
+    assert el["current_np"] == 2
+    text = doctor.render_text(diag)
+    assert "elastic: 2 decision(s) (arbiter on)" in text
+    assert ("[grow] np 1 -> 2 (capacity_returned): resize "
+            "from step 6") in text
+    assert "[grow] np 2 -> 4 (unprofitable): refused" in text
+
+
+def test_doctor_without_elastic_has_no_section(tmp_path):
+    run_dir = _run_dir(tmp_path, [])
+    diag = doctor.diagnose(run_dir)
+    assert diag["elastic"] is None
+    assert "elastic:" not in doctor.render_text(diag)

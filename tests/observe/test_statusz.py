@@ -229,6 +229,67 @@ def test_fleet_registration_renders_replica_table():
     assert len(statusz_mod.fleet_status()) == 1
 
 
+# -- elastic visibility (ISSUE 16) -------------------------------------------
+
+
+def test_statusz_elastic_section_and_chip_hours(monkeypatch):
+    """ISSUE 16: /statusz shows current vs available chips next to the
+    per-attempt chip-hour utilization ledger (world x wall duration,
+    the last attempt priced up to now)."""
+    from sparkdl_tpu.horovod import supervisor
+    from sparkdl_tpu.horovod.elastic import ElasticController
+
+    t0 = time.time() - 7200.0
+    monkeypatch.setattr(supervisor, "_attempt_worlds", [2, 1])
+    monkeypatch.setattr(supervisor, "_attempt_stamps",
+                        [t0, t0 + 3600.0])
+    ctrl = ElasticController(
+        1, env={"SPARKDL_TPU_ELASTIC": "1"}, probe=lambda: 4,
+        clock=lambda: 0.0, latest_step=lambda: 7,
+        resume_dir="/tmp/ck")
+    ctrl.poll(now=0.0)
+    server = StatuszServer(GangTelemetry(), num_workers=1,
+                           elastic=ctrl).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        el = doc["elastic"]
+        assert el["enabled"] is True
+        assert el["current_np"] == 1
+        assert el["available_np"] == 4
+        assert el["pending"] is None
+        sup = doc["supervisor"]
+        # attempt 1: 2 chips x 1h; attempt 2: 1 chip x ~1h (to now)
+        assert [e["world"] for e in sup["chip_hours"]] == [2, 1]
+        assert sup["chip_hours"][0]["chip_hours"] == pytest.approx(
+            2.0, rel=0.01)
+        assert sup["chip_hours_total"] == pytest.approx(3.0, rel=0.01)
+    finally:
+        server.close()
+
+
+def test_statusz_no_elastic_section_without_controller():
+    server = StatuszServer(GangTelemetry(), num_workers=1).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        assert "elastic" not in doc
+    finally:
+        server.close()
+
+
+def test_live_fleets_returns_objects_and_prunes():
+    class FakeFleet:
+        pass
+
+    fleet = FakeFleet()
+    statusz_mod.register_fleet(fleet)
+    assert statusz_mod.live_fleets() == [fleet]
+    del fleet
+    import gc
+
+    gc.collect()
+    assert statusz_mod.live_fleets() == []
+
+
 # -- the real thing: scraped mid-run -----------------------------------------
 
 

@@ -207,8 +207,10 @@ def test_restart_context_emits_one_resume_marker(monkeypatch, tmp_path):
     monkeypatch.setattr(sh, "_resume_instant_emitted", False)
     for _ in range(5):
         ctx = sh.restart_context()
-    assert ctx == (1, 7)
+    assert (ctx.attempt, ctx.resume_step) == (1, 7)
     events = [e for e in observe.timeline().drain()
               if e["name"] == "gang.resume"]
     assert len(events) == 1
-    assert events[0]["args"] == {"attempt": 1, "resume_step": 7}
+    args = events[0]["args"]
+    assert args["attempt"] == 1
+    assert args["resume_step"] == 7
