@@ -24,7 +24,7 @@ import argparse
 import json
 import sys
 
-from sparkdl_tpu.analysis.core import Severity, max_severity
+from sparkdl_tpu.analysis.core import Finding, Severity, max_severity
 
 
 def _load_graft_entry():
@@ -147,6 +147,26 @@ def main(argv=None):
         help="lint the repo's own package, examples/, and driver entry",
     )
     parser.add_argument(
+        "--concur", action="store_true",
+        help="concurrency lint (lock-order graph, blocking-under-"
+             "lock, shared state, thread lifecycle, collective "
+             "program order) over sparkdl_tpu/ — or over the "
+             "positional paths when given; the committed waiver "
+             "baseline is subtracted and the exit code trips on any "
+             "non-waived WARNING+ finding",
+    )
+    parser.add_argument(
+        "--concur-baseline", metavar="PATH", default=None,
+        help="waiver baseline for --concur (default: the committed "
+             "sparkdl_tpu/analysis/concur_baseline.json; 'none' "
+             "disables waivers)",
+    )
+    parser.add_argument(
+        "--concur-out", metavar="PATH", default=None,
+        help="write the full --concur findings JSON (waived included, "
+             "flagged) to PATH (CI artifact)",
+    )
+    parser.add_argument(
         "--graft", type=int, metavar="N", default=None,
         help="graph-lint the N-device multichip driver program",
     )
@@ -183,10 +203,11 @@ def main(argv=None):
         "--format", choices=("text", "json"), default="text",
     )
     parser.add_argument(
-        "--fail-on", default="error",
+        "--fail-on", default=None,
         choices=("error", "warning", "info", "never"),
         help="exit 1 when any finding reaches this severity "
-             "(default: error)",
+             "(default: error; warning with --concur, so the gate "
+             "trips on any non-waived finding)",
     )
     parser.add_argument(
         "--list-passes", action="store_true",
@@ -235,11 +256,48 @@ def main(argv=None):
     findings = []
     comms_reports = []
     fixit_reports = []
-    targets = list(args.paths)
+    # With --concur the positional paths feed the concurrency lint;
+    # the pickling-contract lint still runs via --self.
+    targets = [] if args.concur else list(args.paths)
     if args.self_lint:
         targets.extend(self_targets())
     if targets:
         findings.extend(lint_paths(targets))
+    n_waived = n_stale = 0
+    if args.concur:
+        from sparkdl_tpu.analysis import concur
+
+        ctargets = (list(args.paths) if args.paths
+                    else concur.self_runtime_targets())
+        raw = concur.lint_paths(ctargets)
+        if args.concur_baseline == "none":
+            waivers = []
+        else:
+            waivers = concur.load_baseline(args.concur_baseline)
+        kept, waived, stale = concur.apply_baseline(raw, waivers)
+        n_waived, n_stale = len(waived), len(stale)
+        findings.extend(kept)
+        for w in stale:
+            findings.append(Finding(
+                rule_id=w.get("rule", "concur-baseline"),
+                severity=Severity.INFO,
+                op=w.get("op", ""), location=w.get("path", ""),
+                message=("stale waiver (no matching finding) — "
+                         "remove it from concur_baseline.json: "
+                         f"{w.get('reason', '')}"),
+            ))
+        if args.concur_out:
+            waived_keys = {id(f) for f in waived}
+            doc = {
+                "schema": concur.REPORT_SCHEMA,
+                "findings": [
+                    dict(f.to_dict(), waived=id(f) in waived_keys)
+                    for f in raw
+                ],
+                "stale_waivers": stale,
+            }
+            with open(args.concur_out, "w") as f:
+                json.dump(doc, f, indent=2)
     if args.graft is not None:
         graft_findings, report, fixit_report = _graft_findings(
             args.graft, with_comms=want_comms, fix=want_fix,
@@ -249,8 +307,9 @@ def main(argv=None):
             comms_reports.append(report)
         if fixit_report is not None:
             fixit_reports.append(fixit_report)
-    if not targets and args.graft is None:
-        parser.error("nothing to lint: give paths, --self, or --graft N")
+    if not targets and args.graft is None and not args.concur:
+        parser.error("nothing to lint: give paths, --self, --concur, "
+                     "or --graft N")
 
     if args.comms_out and comms_reports:
         from sparkdl_tpu.analysis.comms import write_report
@@ -280,6 +339,13 @@ def main(argv=None):
         print(f"-- {len(findings)} finding(s): {n_err} error(s), "
               f"{n_warn} warning(s)"
               + (" (after --fix)" if want_fix else ""))
+        if args.concur:
+            print(f"-- concur: {n_waived} finding(s) waived via "
+                  f"baseline, {n_stale} stale waiver(s)")
+            from sparkdl_tpu.analysis import concur
+
+            for line in concur.render_suggestions(findings):
+                print(line)
         if fixit_reports:
             from sparkdl_tpu.analysis.fixes import render_fixit_text
 
@@ -287,9 +353,10 @@ def main(argv=None):
                 print(render_fixit_text(rep))
         for report in comms_reports:
             print(_render_comms(report))
-    if args.fail_on != "never":
+    fail_on = args.fail_on or ("warning" if args.concur else "error")
+    if fail_on != "never":
         top = max_severity(findings)
-        if top is not None and top >= Severity.parse(args.fail_on):
+        if top is not None and top >= Severity.parse(fail_on):
             return 1
     return 0
 

@@ -168,7 +168,7 @@ def rule_catalog():
     ``rule_id -> (severities, one_liner)`` in registration order."""
     _load_builtin_passes()
     # Imported for their register_rule_info side effects.
-    from sparkdl_tpu.analysis import comms, selflint  # noqa: F401
+    from sparkdl_tpu.analysis import comms, concur, selflint  # noqa: F401
 
     out = {
         rule_id: (p.severities, p.doc)

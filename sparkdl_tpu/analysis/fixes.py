@@ -69,6 +69,15 @@ FIX_ACTIONS = {
         "explicitly cast 64-bit argument leaves to 32 bits (logged), "
         "refusing any integer that does not round-trip",
     ),
+    # Source-level mechanical class from the concurrency lint: the
+    # engine cannot rewrite source files, so the action is rendered as
+    # a per-site suggestion by `--concur` (concur.render_suggestions)
+    # rather than applied by fix_program.
+    "thread-lifecycle": (
+        "daemonize-unjoined-thread",
+        "suggest daemon=True (or a shutdown-path join) for a "
+        "non-daemon helper thread that is never joined",
+    ),
 }
 
 # float64 -> float32 etc. for the narrowing fixer.

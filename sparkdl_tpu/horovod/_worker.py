@@ -190,6 +190,12 @@ def worker_io(rank, local_log_path=None):
 
 def main():
     from sparkdl_tpu.hvd import _state
+    from sparkdl_tpu.utils import locksan
+
+    # Opt-in lock-order sanitizer: must run before any worker-side
+    # lock is constructed (control-plane client, observe sinks) so the
+    # observed acquisition-order graph covers them all.
+    locksan.maybe_install()
 
     rank = int(os.environ["SPARKDL_TPU_RANK"])
     job_dir = os.environ["SPARKDL_TPU_JOB_DIR"]

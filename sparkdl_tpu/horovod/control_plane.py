@@ -214,7 +214,16 @@ class ControlPlaneServer:
                 name="sparkdl-tpu-control-conn", daemon=True,
             )
             t.start()
-            self._threads.append(t)
+            # _threads is read by wait_drained() from the driver
+            # thread while this accept thread appends: share it under
+            # the lock, and prune finished handlers so a chatty gang
+            # (reconnects, per-attempt clients) cannot grow the list
+            # for the life of the server.
+            with self._lock:
+                self._threads = [
+                    x for x in self._threads if x.is_alive()
+                ]
+                self._threads.append(t)
 
     def _log_server_event(self, text):
         with self._lock:
@@ -432,7 +441,11 @@ class ControlPlaneServer:
         the handler threads finish, no log line can arrive late (the
         tail-of-job guarantee behind the 'all'-verbosity contract)."""
         deadline = time.monotonic() + timeout
-        for t in list(self._threads):
+        with self._lock:
+            threads = list(self._threads)
+        # join OUTSIDE the lock: handlers take it to record results,
+        # and a join-under-lock would deadlock the drain.
+        for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
 
     def request_dump(self, rank, reason="stall"):

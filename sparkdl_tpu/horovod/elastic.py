@@ -403,16 +403,22 @@ class ElasticController:
         with self._lock:
             self.available_np = cap
             req = self._ripen_pending(now)
-            if req is not None:
-                return req
-            if self._pending is not None:
-                return None  # still waiting for the next checkpoint
-            plan = self._arbiter_plan(now)
-            if plan is not None:
-                self._plan(plan, now)
-                return None
-            self._grow_watch(now, cap)
-            return None
+            if req is None and self._pending is None:
+                plan = self._arbiter_plan(now)
+                if plan is not None:
+                    self._plan(plan, now)
+                else:
+                    self._grow_watch(now, cap)
+        # The colocated-fleet resize joins retired worker threads
+        # (FleetFrontend.scale_to blocks for seconds): it must run
+        # after the lock is released, or every status()/
+        # relaunch_target() caller on other threads queues behind it.
+        if req is not None:
+            if req["direction"] == "yield":
+                self._scale_fleet(grow=True)
+            elif req["direction"] == "reclaim":
+                self._scale_fleet(grow=False)
+        return req
 
     def _ripen_pending(self, now):
         pend = self._pending
@@ -455,10 +461,8 @@ class ElasticController:
             "elastic %s: recycling the gang np %s -> %s (%s), resuming "
             "from step %s", pend["direction"], self.current_np,
             pend["target_np"], pend["reason"], step)
-        if pend["direction"] == "yield":
-            self._scale_fleet(grow=True)
-        elif pend["direction"] == "reclaim":
-            self._scale_fleet(grow=False)
+        # The matching fleet resize happens in poll(), OUTSIDE the
+        # controller lock — scale_to joins worker threads.
         return {"direction": pend["direction"],
                 "target_np": pend["target_np"],
                 "reason": pend["reason"], "resume_step": step}

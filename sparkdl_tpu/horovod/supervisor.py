@@ -530,6 +530,12 @@ def supervise(launch, policy, _sleep=time.sleep):
     newest committed checkpoint step.
     """
     from sparkdl_tpu import observe
+    from sparkdl_tpu.utils import locksan
+
+    # Opt-in lock-order sanitizer (SPARKDL_TPU_CONCUR_SAN=1): installed
+    # before the supervisor spins up control plane / elastic threads so
+    # every lock they construct is instrumented from birth.
+    locksan.maybe_install()
 
     attempts = []
     attempt = 1

@@ -106,7 +106,7 @@ class _WorkerTelemetry:
                      free_pages=None, n_pages=None):
         # every chunk is liveness evidence — the hang detector keys
         # off this stamp, so a slow-but-moving replica is never killed
-        self._worker.last_progress = time.monotonic()
+        self._worker._touch_progress()
         self._metrics.histogram(
             "engine_batch_utilization", buckets=_UTIL_BUCKETS
         ).observe(active / max(1, n_slots))
@@ -128,7 +128,7 @@ class EngineWorker:
         self._metrics = metrics
         self._arrivals = queue.Queue()   # (parsed request, _Mailbox)
         self._live = {}                  # engine rid -> _Mailbox
-        self._lock = threading.Lock()    # guards _live + dead flag
+        self._lock = threading.Lock()    # guards _live + dead flag + last_progress
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._crash = None
@@ -189,6 +189,14 @@ class EngineWorker:
         self._stop.set()
         self._wake.set()
 
+    def _touch_progress(self):
+        """Liveness stamp, written under the lock: the engine thread
+        (chunks, tokens, queue polls), handler threads (idle-arrival
+        reset in submit) and the supervisor's hung() read all touch
+        it — one guarded writer path keeps the updates ordered."""
+        with self._lock:
+            self.last_progress = time.monotonic()
+
     def join(self, timeout=None):
         self._thread.join(timeout)
 
@@ -221,7 +229,9 @@ class EngineWorker:
         if self.dead or not self.depth:
             return False
         now = time.monotonic() if now is None else now
-        return now - self.last_progress > hang_seconds
+        with self._lock:
+            last = self.last_progress
+        return now - last > hang_seconds
 
     # -- engine thread -------------------------------------------------
 
@@ -245,7 +255,7 @@ class EngineWorker:
     def _poll_queue(self, _engine):
         """Drain arrivals into engine.submit — between bursts AND from
         run()'s progress hook (mid-burst admission)."""
-        self.last_progress = time.monotonic()
+        self._touch_progress()
         while True:
             try:
                 parsed, box = self._arrivals.get_nowait()
@@ -284,7 +294,7 @@ class EngineWorker:
             # its tokens go nowhere (the client already got its 500)
             return
         now = time.perf_counter()
-        self.last_progress = time.monotonic()
+        self._touch_progress()
         self._metrics.counter("server_generated_tokens_total").inc()
         if not box.first_token_seen:
             box.first_token_seen = True

@@ -101,24 +101,22 @@ def fleet_status():
     none registered (the /statusz key is absent rather than empty —
     gang-only runs have no fleet section at all)."""
     out = []
-    with _fleets_lock:
-        live = []
-        for ref in _fleets:
-            fleet = ref()
-            if fleet is None:
-                continue
-            live.append(ref)
-            try:
-                out.append({
-                    "address": list(fleet.address),
-                    "replicas": fleet.replica_states(),
-                    "restarts": fleet._restarts,
-                    "max_queue": fleet.max_queue,
-                    "queue_depth": fleet.queue_depth(),
-                })
-            except Exception:
-                continue
-        _fleets[:] = live
+    # Snapshot the live fleets under the registry lock, then build
+    # the rows OUTSIDE it: replica_states()/queue_depth() take each
+    # fleet's own locks, and holding the module registry lock across
+    # foreign lock acquisitions couples every statusz reader to every
+    # fleet's internals (lock-order hygiene; see analysis.concur).
+    for fleet in live_fleets():
+        try:
+            out.append({
+                "address": list(fleet.address),
+                "replicas": fleet.replica_states(),
+                "restarts": fleet._restarts,
+                "max_queue": fleet.max_queue,
+                "queue_depth": fleet.queue_depth(),
+            })
+        except Exception:
+            continue
     return out or None
 
 

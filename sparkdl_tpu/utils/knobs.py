@@ -324,6 +324,22 @@ def _build():
           "launcher pre-flight: run the verified fix engine over "
           "registered callable steps"),
 
+        # -- concurrency sanitizer (utils.locksan) ------------------
+        k("SPARKDL_TPU_CONCUR_SAN", "bool", "0", "analysis",
+          "instrument threading.Lock/RLock at boot: record per-"
+          "thread acquisition stacks, build the observed lock-order "
+          "graph, report inversions/cycles and long holds "
+          "(concur_report.json + concur.* timeline instants)"),
+        k("SPARKDL_TPU_CONCUR_HOLD_WARN_S", "float", "1.0", "analysis",
+          "sanitizer long-hold threshold: a lock held at least this "
+          "many seconds lands in the report"),
+        k("SPARKDL_TPU_CONCUR_REPORT", "path", None, "analysis",
+          "sanitizer report destination; default "
+          "$SPARKDL_TPU_TELEMETRY_DIR/concur_report.json when "
+          "telemetry is on, else no file"),
+        k("SPARKDL_TPU_CONCUR_STACK_DEPTH", "int", "12", "analysis",
+          "frames kept per recorded acquisition stack"),
+
         # -- observability ------------------------------------------
         k("SPARKDL_TPU_TELEMETRY_DIR", "path", None, "observe",
           "opt-in telemetry root (run-* dirs)"),

@@ -1,0 +1,452 @@
+"""Concurrency lint: one synthetic violation + clean pair per rule,
+the PR-16 allreduce_async regression shape, baseline waiver
+semantics, and the CLI gate contract (in-process main(), like
+test_cli.py — no subprocess jax imports in the tier-1 box)."""
+
+import json
+import textwrap
+
+import pytest
+
+from sparkdl_tpu.analysis import Severity
+from sparkdl_tpu.analysis.__main__ import main
+from sparkdl_tpu.analysis.concur import (
+    ALLOW_COMMENT,
+    BASELINE_SCHEMA,
+    DEFAULT_BASELINE,
+    RULE_BLOCKING,
+    RULE_COLLECTIVE,
+    RULE_LIFECYCLE,
+    RULE_LOCK_ORDER,
+    RULE_SHARED_STATE,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    self_runtime_targets,
+)
+
+
+def lint(src):
+    return lint_source(textwrap.dedent(src), filename="mod.py")
+
+
+def rules(findings):
+    return sorted(f.rule_id for f in findings)
+
+
+# ---------------------------------------------------------------- #
+# lock-order-cycle                                                 #
+# ---------------------------------------------------------------- #
+
+def test_ab_ba_order_is_a_cycle():
+    fs = lint("""
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """)
+    assert rules(fs) == [RULE_LOCK_ORDER]
+    f = fs[0]
+    assert f.severity == Severity.ERROR
+    assert "mod._a" in f.op and "mod._b" in f.op
+
+
+def test_consistent_order_is_clean():
+    fs = lint("""
+        import threading
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _a:
+                with _b:
+                    pass
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------- #
+# blocking-call-under-lock                                         #
+# ---------------------------------------------------------------- #
+
+def test_subprocess_under_lock():
+    fs = lint("""
+        import threading, subprocess
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                subprocess.run(["ls"])
+    """)
+    assert rules(fs) == [RULE_BLOCKING]
+    assert fs[0].severity == Severity.ERROR
+    assert fs[0].op == "subprocess.run"
+
+
+def test_blocking_is_found_through_a_helper_call():
+    # The verdict propagates transitively: f holds the lock, helper
+    # does the blocking — the finding lands on f's call site.
+    fs = lint("""
+        import threading, subprocess
+        _lock = threading.Lock()
+
+        def helper():
+            subprocess.run(["ls"])
+
+        def f():
+            with _lock:
+                helper()
+    """)
+    assert rules(fs) == [RULE_BLOCKING]
+    assert fs[0].op == "helper"
+    assert "subprocess" in fs[0].message
+
+
+def test_blocking_outside_lock_is_clean():
+    fs = lint("""
+        import threading, subprocess
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                pass
+            subprocess.run(["ls"])
+    """)
+    assert fs == []
+
+
+def test_inline_suppression_comment():
+    fs = lint(f"""
+        import threading, subprocess
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                subprocess.run(["ls"])  {ALLOW_COMMENT}
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------- #
+# unguarded-shared-state                                           #
+# ---------------------------------------------------------------- #
+
+def test_write_from_thread_and_caller_without_lock():
+    fs = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(
+                    target=self._loop, daemon=True)
+
+            def _loop(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+    """)
+    assert rules(fs) == [RULE_SHARED_STATE]
+    assert fs[0].severity == Severity.WARNING
+    assert fs[0].op == "W.count"
+
+
+def test_guarded_writes_are_clean():
+    fs = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(
+                    target=self._loop, daemon=True)
+
+            def _loop(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------- #
+# thread-lifecycle                                                 #
+# ---------------------------------------------------------------- #
+
+def test_non_daemon_thread_never_joined():
+    fs = lint("""
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+    """)
+    assert rules(fs) == [RULE_LIFECYCLE]
+    assert "never joined" in fs[0].message
+
+
+def test_daemon_or_joined_threads_are_clean():
+    fs = lint("""
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+            u = threading.Thread(target=print)
+            u.start()
+            u.join()
+    """)
+    assert fs == []
+
+
+def test_condition_wait_outside_predicate_loop():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    self._cv.wait()
+    """)
+    assert rules(fs) == [RULE_LIFECYCLE]
+    assert "while" in fs[0].message
+
+
+def test_condition_wait_in_while_loop_is_clean():
+    fs = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------- #
+# collective-enqueue-off-thread — the PR 16 regression shape       #
+# ---------------------------------------------------------------- #
+
+# The ORIGINAL hvd.allreduce_async bug: the dispatch half (start())
+# ran on the pool thread, so backend submission order raced the step
+# thread's own collectives into rank-dependent order and the gang
+# deadlocked. The pass must flag this shape forever.
+PR16_BROKEN = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Engine:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(1)
+
+        def submit_async(self, op_name, start, nbytes=0):
+            def run():
+                finish = start()
+                return finish()
+            return self._pool.submit(run)
+"""
+
+# The shipped fix: enqueue on the calling thread, hand only the
+# blocking finish half to the pool.
+PR16_FIXED = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Engine:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(1)
+
+        def submit_async(self, op_name, start, nbytes=0):
+            finish = start()
+            def finish_observed():
+                return finish()
+            return self._pool.submit(finish_observed)
+"""
+
+
+def test_pr16_pool_thread_dispatch_is_flagged():
+    fs = lint(PR16_BROKEN)
+    assert rules(fs) == [RULE_COLLECTIVE]
+    assert fs[0].severity == Severity.ERROR
+    assert "allreduce_async" in fs[0].message
+
+
+def test_pr16_fixed_shape_is_clean():
+    assert lint(PR16_FIXED) == []
+
+
+def test_jax_lax_collective_in_submitted_lambda():
+    fs = lint("""
+        import jax
+
+        def go(pool, x):
+            return pool.submit(lambda: jax.lax.psum(x, "i"))
+    """)
+    assert rules(fs) == [RULE_COLLECTIVE]
+    assert "jax.lax.psum" in fs[0].message
+
+
+def test_repo_submit_async_stays_clean():
+    # The live fixed implementation must never re-trip the pass.
+    fs = lint_paths(["sparkdl_tpu/hvd/_collectives.py"])
+    assert [f for f in fs if f.rule_id == RULE_COLLECTIVE] == []
+
+
+# ---------------------------------------------------------------- #
+# baseline waiver semantics                                        #
+# ---------------------------------------------------------------- #
+
+BLOCKING_SRC = """
+    import threading, subprocess
+    _lock = threading.Lock()
+
+    def f():
+        with _lock:
+            subprocess.run(["ls"])
+"""
+
+
+def test_waiver_matches_by_rule_path_op_not_line():
+    fs = lint(BLOCKING_SRC)
+    w = {"rule": RULE_BLOCKING, "path": "mod.py",
+         "op": "subprocess.run", "reason": "by design"}
+    kept, waived, stale = apply_baseline(fs, [w])
+    assert kept == [] and len(waived) == 1 and stale == []
+
+    # Same waiver still matches after the line number moves.
+    fs2 = lint("\n\n\n" + textwrap.dedent(BLOCKING_SRC))
+    assert fs2[0].location != fs[0].location
+    kept2, waived2, _ = apply_baseline(fs2, [w])
+    assert kept2 == [] and len(waived2) == 1
+
+
+def test_unmatched_waiver_is_stale_and_finding_is_kept():
+    fs = lint(BLOCKING_SRC)
+    w = {"rule": RULE_BLOCKING, "path": "other.py",
+         "op": "subprocess.run", "reason": "elsewhere"}
+    kept, waived, stale = apply_baseline(fs, [w])
+    assert len(kept) == 1 and waived == [] and stale == [w]
+
+
+def test_waiver_without_reason_is_rejected(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({
+        "schema": BASELINE_SCHEMA,
+        "waivers": [{"rule": RULE_BLOCKING, "path": "x.py",
+                     "op": "subprocess.run"}],
+    }))
+    with pytest.raises(ValueError, match="no reason"):
+        load_baseline(p)
+
+
+def test_unknown_baseline_schema_is_rejected(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"schema": "nope/9", "waivers": []}))
+    with pytest.raises(ValueError, match="schema"):
+        load_baseline(p)
+
+
+def test_committed_baseline_loads_and_every_waiver_has_a_reason():
+    waivers = load_baseline(DEFAULT_BASELINE)
+    assert waivers, "committed baseline must carry the day-one waivers"
+    assert all(w["reason"] for w in waivers)
+
+
+# ---------------------------------------------------------------- #
+# self-lint + CLI gate                                             #
+# ---------------------------------------------------------------- #
+
+def test_runtime_surface_clean_modulo_committed_baseline():
+    fs = lint_paths(self_runtime_targets())
+    kept, _waived, stale = apply_baseline(
+        [f for f in fs if f.severity != Severity.INFO],
+        load_baseline())
+    assert kept == [], [str(f) for f in kept]
+    assert stale == [], stale
+
+
+def test_cli_concur_gate_is_green_with_baseline(capsys):
+    assert main(["--concur"]) == 0
+    out = capsys.readouterr().out
+    assert "waived via baseline" in out
+
+
+def test_cli_concur_without_baseline_fails(capsys):
+    # The waived findings are real: with the baseline disabled the
+    # gate must go red (this is what CI enforces for NEW findings).
+    assert main(["--concur", "--concur-baseline", "none"]) == 1
+    out = capsys.readouterr().out
+    assert RULE_BLOCKING in out
+
+
+def test_cli_concur_on_explicit_bad_file(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(BLOCKING_SRC))
+    assert main(["--concur", "--concur-baseline", "none",
+                 str(p)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_concur_out_artifact(tmp_path, capsys):
+    out_path = tmp_path / "concur_report.json"
+    assert main(["--concur", "--concur-out", str(out_path)]) == 0
+    capsys.readouterr()
+    doc = json.loads(out_path.read_text())
+    assert doc["schema"].startswith("sparkdl_tpu.analysis.")
+    assert doc["stale_waivers"] == []
+    assert all(f["waived"] for f in doc["findings"])
+
+
+def test_cli_concur_stale_waiver_surfaces_as_info(tmp_path, capsys):
+    base = {
+        "schema": BASELINE_SCHEMA,
+        "waivers": [{"rule": RULE_BLOCKING, "path": "ghost.py",
+                     "op": "nothing", "reason": "stale on purpose"}],
+    }
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps(base))
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    assert main(["--concur", "--concur-baseline", str(bp),
+                 str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "1 stale waiver(s)" in out
+
+
+def test_syntax_error_is_info_not_crash(tmp_path):
+    fs = lint_source("def broken(:\n", filename="b.py")
+    assert len(fs) == 1
+    assert fs[0].severity == Severity.INFO
+    assert fs[0].op == "parse"

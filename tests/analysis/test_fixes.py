@@ -246,7 +246,7 @@ class TestFixitReport:
     def test_every_fixable_rule_has_an_action(self):
         assert set(FIX_ACTIONS) == {
             "undonated-step-buffers", "host-sync-in-step",
-            "silent-canonicalization",
+            "silent-canonicalization", "thread-lifecycle",
         }
 
     def test_render_text_mentions_state_and_proofs(self):

@@ -184,3 +184,42 @@ def test_client_refuses_to_run_without_secret(server, monkeypatch):
     monkeypatch.delenv(CONTROL_SECRET_ENV, raising=False)
     with pytest.raises(RuntimeError, match="secret"):
         ControlPlaneClient(server.address, rank=0)
+
+
+def test_handler_threads_are_pruned_and_drain_joins_outside_lock(
+        server):
+    """Regression (analysis.concur thread-lifecycle /
+    blocking-call-under-lock): each accepted connection's handler
+    thread is tracked under the server lock and dead handlers are
+    pruned on the next accept — the list must not grow without bound
+    — and wait_drained joins a SNAPSHOT outside the lock (handlers
+    take it to record results; a join-under-lock deadlocks the
+    drain)."""
+    import time
+
+    for _ in range(5):
+        c = ControlPlaneClient(server.address, rank=0,
+                               secret=server.secret)
+        c.send_ready()
+        c.close()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with server._lock:
+            alive = [t for t in server._threads if t.is_alive()]
+        if not alive:
+            break
+        time.sleep(0.02)
+    # one more accept triggers the prune of the dead handlers
+    c = ControlPlaneClient(server.address, rank=1,
+                           secret=server.secret)
+    c.send_ready()
+    time.sleep(0.2)
+    with server._lock:
+        n = len(server._threads)
+    assert n <= 2, n
+    c.close()
+    # drain must finish promptly even while the server lock is being
+    # exercised: wait_drained snapshots then joins outside the lock
+    t0 = time.monotonic()
+    server.wait_drained(timeout=5.0)
+    assert time.monotonic() - t0 < 5.0

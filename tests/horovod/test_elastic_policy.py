@@ -312,3 +312,30 @@ def test_status_reports_current_vs_available():
     rep = ctrl.report()
     assert rep["schema"] == elastic.ELASTIC_SCHEMA
     assert rep["decisions"] == []
+
+
+def test_fleet_resize_runs_outside_the_controller_lock(monkeypatch):
+    """Regression (analysis.concur blocking-call-under-lock):
+    _scale_fleet joins retired worker threads for seconds, so a ripe
+    yield/reclaim plan must trigger it only AFTER poll() releases the
+    controller lock — or every status()/relaunch_target() caller on
+    other threads queues behind the join."""
+    step = {"v": 5}
+    ctrl = _controller([2], lambda: step["v"])
+    calls = []
+
+    def probe_scale(grow):
+        free = ctrl._lock.acquire(blocking=False)
+        if free:
+            ctrl._lock.release()
+        calls.append((grow, free))
+
+    monkeypatch.setattr(ctrl, "_scale_fleet", probe_scale)
+    ctrl._pending = {"direction": "yield", "reason": "server_ttft",
+                     "target_np": 1, "planned_at": 0.0,
+                     "decided_step": 5, "emitted": False}
+    step["v"] = 6                        # checkpoint boundary reached
+    req = ctrl.poll(now=0.0)
+    assert req is not None and req["direction"] == "yield"
+    # exactly one scale call, with the controller lock released
+    assert calls == [(True, True)]

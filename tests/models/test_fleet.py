@@ -478,3 +478,34 @@ def test_simultaneous_burst_spreads_across_replicas():
     finally:
         gate.set()
         fleet.close()
+
+
+def test_last_progress_writes_go_through_the_worker_lock():
+    """Regression (analysis.concur unguarded-shared-state):
+    last_progress is written by the engine thread (chunks, tokens,
+    queue polls) AND handler threads (idle-arrival reset in submit),
+    and read by the supervisor's hung() — every write must go through
+    _touch_progress() under the worker lock."""
+    from sparkdl_tpu.observe.metrics import Registry
+
+    w = EngineWorker(0, _FakeEngine, Registry())
+    before = w.last_progress
+    # _touch_progress takes the lock itself; with the lock held by
+    # another party, an unguarded write would have raced straight
+    # through — the guarded one must wait, proving the stamp is
+    # serialized with _lock.
+    acquired = w._lock.acquire()
+    assert acquired
+    t = threading.Thread(target=w._touch_progress)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive()                 # blocked on the worker lock
+    assert w.last_progress == before    # no torn write slipped through
+    w._lock.release()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert w.last_progress > before
+    # the telemetry hook stamps through the same guarded path
+    mid = w.last_progress
+    w.engine.telemetry.decode_chunk(active=1, n_slots=1, n_tokens=1)
+    assert w.last_progress >= mid

@@ -423,3 +423,40 @@ def test_statusz_scraped_mid_run_and_clean_run_fires_no_alert(
     assert alerts["alerts"] == []
     assert {r["rule"] for r in alerts["rules"]} >= {
         "step_time_regression", "heartbeat_gap", "hbm_high_water"}
+
+
+def test_fleet_status_reads_fleets_outside_registry_lock():
+    """Regression (analysis.concur lock-order hygiene): fleet_status
+    must call replica_states()/queue_depth() — which take each
+    fleet's own locks — OUTSIDE _fleets_lock, or every statusz reader
+    couples to every fleet's internal locking."""
+    observed = []
+
+    class ProbingFleet:
+        address = ("127.0.0.1", 1234)
+        max_queue = 4
+        _restarts = 0
+
+        def replica_states(self):
+            free = statusz_mod._fleets_lock.acquire(blocking=False)
+            if free:
+                statusz_mod._fleets_lock.release()
+            observed.append(("replica_states", free))
+            return []
+
+        def queue_depth(self):
+            free = statusz_mod._fleets_lock.acquire(blocking=False)
+            if free:
+                statusz_mod._fleets_lock.release()
+            observed.append(("queue_depth", free))
+            return 0
+
+    fleet = ProbingFleet()
+    statusz_mod.register_fleet(fleet)
+    try:
+        rows = statusz_mod.fleet_status()
+        assert rows and rows[0]["queue_depth"] == 0
+        assert observed == [("replica_states", True),
+                            ("queue_depth", True)]
+    finally:
+        statusz_mod.unregister_fleet(fleet)

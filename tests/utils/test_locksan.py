@@ -1,0 +1,233 @@
+"""Lock-order sanitizer: a seeded two-thread ABBA inversion must be
+witnessed with BOTH acquisition stacks, clean runs must report
+nothing, and the report artifact must round-trip. Tests construct
+SanLock/SanRLock directly where possible so the global factory patch
+(install) stays confined to the tests that exercise it."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu.utils import locksan
+from sparkdl_tpu.utils.locksan import (
+    HOLD_WARN_ENV,
+    REPORT_SCHEMA,
+    SAN_ENV,
+    SanLock,
+    SanRLock,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    locksan.reset()
+    yield
+    locksan.uninstall()
+    locksan.reset()
+
+
+def _run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_seeded_abba_inversion_witnessed_with_both_stacks():
+    a = SanLock()
+    b = SanLock()
+
+    # Sequential, not temporally overlapped — the sanitizer's whole
+    # point is catching the ORDER hazard without needing the actual
+    # deadlock interleaving to fire.
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    _run(t1)
+    _run(t2)
+
+    rep = locksan.report()
+    assert len(rep["inversions"]) == 1
+    inv = rep["inversions"][0]
+    assert sorted(inv["locks"]) == sorted([a._site, b._site])
+    # Both orders carry both stacks: what was held, what was being
+    # acquired — this is the actionable part of the report.
+    for side in (inv["first"], inv["second"]):
+        assert "test_locksan" in side["held_stack"]
+        assert "test_locksan" in side["acquiring_stack"]
+    assert inv["first"]["order"] != inv["second"]["order"]
+    # ...and the cycle detector agrees.
+    assert sorted([a._site, b._site]) in rep["cycles"]
+
+
+def test_consistent_order_clean_run_reports_nothing():
+    a = SanLock()
+    b = SanLock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with a:
+            with b:
+                pass
+
+    _run(t1)
+    _run(t2)
+
+    rep = locksan.report()
+    assert rep["inversions"] == []
+    assert rep["cycles"] == []
+    assert rep["long_holds"] == []
+    # The consistent edge is still observed (count aggregates).
+    assert [(e["from"], e["to"], e["count"]) for e in rep["edges"]] \
+        == [(a._site, b._site, 2)]
+
+
+def test_independent_locks_record_no_edges():
+    a = SanLock()
+    b = SanLock()
+    with a:
+        pass
+    with b:
+        pass
+    rep = locksan.report()
+    assert rep["edges"] == []
+    assert rep["inversions"] == []
+
+
+def test_long_hold_is_reported(monkeypatch):
+    monkeypatch.setenv(HOLD_WARN_ENV, "0.01")
+    a = SanLock()
+    with a:
+        time.sleep(0.05)
+    rep = locksan.report()
+    assert len(rep["long_holds"]) == 1
+    h = rep["long_holds"][0]
+    assert h["lock"] == a._site
+    assert h["held_s"] >= 0.01
+    assert "test_locksan" in h["stack"]
+
+
+def test_rlock_reentry_is_not_a_self_edge():
+    r = SanRLock()
+    with r:
+        with r:
+            pass
+    rep = locksan.report()
+    assert rep["edges"] == []
+    assert rep["inversions"] == []
+
+
+def test_condition_over_san_rlock_wait_notify():
+    # Condition.wait must fully release a recursively-held SanRLock
+    # (the _release_save/_acquire_restore contract) or the notifier
+    # deadlocks here.
+    r = SanRLock()
+    cv = threading.Condition(r)
+    ready = []
+
+    def waiter():
+        with cv:
+            with r:  # recursive hold across the wait
+                while not ready:
+                    cv.wait(timeout=10)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        ready.append(1)
+        cv.notify()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_install_swaps_factories_and_uninstall_restores():
+    real_lock_type = type(threading.Lock())
+    locksan.install()
+    try:
+        assert locksan.installed()
+        assert isinstance(threading.Lock(), SanLock)
+        assert isinstance(threading.RLock(), SanRLock)
+    finally:
+        locksan.uninstall()
+    assert not locksan.installed()
+    assert isinstance(threading.Lock(), real_lock_type)
+
+
+def test_maybe_install_honors_the_knob():
+    assert locksan.maybe_install(env={}) is False
+    assert not locksan.installed()
+    try:
+        assert locksan.maybe_install(env={SAN_ENV: "1"}) is True
+        assert locksan.installed()
+    finally:
+        locksan.uninstall()
+
+
+def test_write_report_artifact(tmp_path):
+    a = SanLock()
+    b = SanLock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    _run(t1)
+    _run(t2)
+
+    path = tmp_path / "concur_report.json"
+    out = locksan.write_report(str(path))
+    assert out == str(path)
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == REPORT_SCHEMA
+    assert doc["lock_sites"] == 2
+    assert len(doc["inversions"]) == 1
+    assert doc["cycles"]
+
+
+def test_write_report_with_no_destination_is_a_noop(monkeypatch):
+    monkeypatch.delenv(locksan.REPORT_ENV, raising=False)
+    monkeypatch.delenv("SPARKDL_TPU_TELEMETRY_DIR", raising=False)
+    assert locksan.write_report() is None
+
+
+def test_fork_reinit_protocol():
+    """stdlib modules register module-level locks with
+    os.register_at_fork (concurrent.futures.thread's
+    _global_shutdown_lock) — the wrappers must speak CPython's
+    _at_fork_reinit protocol or the first such import under
+    install() dies with AttributeError (found by a sanitized gang
+    checkpointing through orbax)."""
+    a = SanLock()
+    a.acquire()
+    a._at_fork_reinit()
+    assert not a._inner.locked()
+    assert a.acquire(blocking=False)
+    a.release()
+
+    r = SanRLock()
+    r.acquire()
+    r.acquire()
+    r._at_fork_reinit()
+    assert r._owner is None and r._count == 0
+    assert r.acquire(blocking=False)
+    r.release()
