@@ -611,6 +611,15 @@ def main(argv=None):
                      if not k.startswith("_")},
         }
 
+    # Memory high waters (observe.mem): device peak where the backend
+    # reports allocator stats (live-buffer fallback keeps the CPU
+    # proxy non-null) and host RSS high water — the serving-side leak
+    # ledger the rss-growth alert rule judges against.
+    from sparkdl_tpu.observe import mem as mem_acct
+
+    hbm_high_water = mem_acct.device_peak_bytes()
+    host_rss_high_water = mem_acct.host_rss_high_water_bytes()
+
     history = None
     if not args.no_ledger:
         rec = perf.history_record(
@@ -619,7 +628,9 @@ def main(argv=None):
             extra={"mode": args.mode, "streams": args.streams,
                    "replicas": args.replicas,
                    "quant": args.quant or ("ab" if args.ab_quant
-                                           else "bf16")})
+                                           else "bf16"),
+                   "hbm_high_water_bytes": hbm_high_water,
+                   "host_rss_high_water_bytes": host_rss_high_water})
         history = perf.append_history(rec)
 
     record = {
@@ -634,6 +645,8 @@ def main(argv=None):
         "prompt_len": args.prompt_len,
         "max_new_tokens": args.max_new,
         "platform": jax.devices()[0].platform,
+        "hbm_high_water_bytes": hbm_high_water,
+        "host_rss_high_water_bytes": host_rss_high_water,
         "history": history,
     }
     record.update(

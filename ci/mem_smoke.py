@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""CI memory-doctor smoke (ISSUE 18: observability): prove the whole
+memory pipeline against a REAL running gang, end to end:
+
+1. a chaos-injected host leak on rank 1
+   (``SPARKDL_TPU_CHAOS_LEAK_BYTES_PER_STEP``) trips exactly the
+   ``host_rss_growth`` alert — ``alert.*`` instant on the merged
+   timeline, ``gang_alerts_total`` in metrics.prom, an entry in
+   ``alerts.json`` whose detail names the category;
+2. the mid-run ``GET /statusz`` document carries the per-rank memory
+   panel (beacon mem samples lifted off the heartbeats);
+3. ``observe.doctor`` names the leaking category from the artifacts
+   alone and still exits 0 (a leaking run is not a hung or OOM'd one);
+4. an induced allocation failure under ``mem.oom_guard`` writes
+   ``oom_report.json`` with a category table and at least one
+   actionable hint, and the doctor's OOM verdict exits NONZERO.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/mem_smoke.py``
+(defaults the dir to ``./mem-artifacts``). Runs outside the time-boxed
+tier-1 pytest gate — its own workflow step; the run dir, the captured
+statusz document, both doctor reports and the OOM report are left in
+the artifact dir for upload.
+"""
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+# Runnable as `python ci/mem_smoke.py` from a checkout: the script dir
+# (ci/) is sys.path[0], the package root is one up.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEADLINE_S = 300
+# The bound must split two real distributions: a fresh CPU gang's
+# natural early-run RSS growth (imports, jit warmup — measured around
+# 0.8 MB/step in CI) below it, the injected leak well above it.
+LEAK_PER_STEP = 3_000_000        # bytes rank 1 leaks per step
+LEAK_THRESHOLD = 1_800_000       # alert bound (bytes per progress unit)
+
+
+def fail(msg):
+    print(f"MEM SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _leaky_main(n_steps, step_s):
+    """Chaos-aware training main: every step calls ``chaos_step``, so
+    the configured leak injector grows rank 1's host heap while the
+    steps themselves stay healthy (a leak is a trend, not a slowdown)."""
+    import time as _time
+
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.parallel.train import instrument_step
+    from sparkdl_tpu.utils import chaos
+
+    hvd.init()
+
+    def step(i):
+        chaos.chaos_step(i)
+        _time.sleep(step_s)
+        return i
+
+    stepped = instrument_step(step)
+    for i in range(n_steps):
+        stepped(i)
+    return hvd.rank()
+
+
+class Scraper(threading.Thread):
+    """Mid-run evidence collector: polls /statusz for the memory panel
+    while the gang runs on the main thread."""
+
+    def __init__(self, base):
+        super().__init__(name="mem-smoke-scraper", daemon=True)
+        self.base = base
+        self.memory_doc = None
+
+    def run(self):
+        deadline = time.monotonic() + DEADLINE_S
+        while time.monotonic() < deadline:
+            try:
+                doc = json.loads(_get(f"{self.base}/statusz"))
+                panel = doc.get("memory") or {}
+                if self.memory_doc is None and any(
+                        (entry or {}).get("rss_bytes")
+                        for entry in panel.values()):
+                    self.memory_doc = doc
+                    return
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.15)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "mem-artifacts"),
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    port = _free_port()
+    os.environ.update({
+        "SPARKDL_TPU_TELEMETRY_FLUSH_S": "0.1",
+        "SPARKDL_TPU_HEARTBEAT_S": "0.2",
+        "SPARKDL_TPU_MEM_SAMPLE_S": "0.1",
+        "SPARKDL_TPU_STATUSZ_PORT": str(port),
+        "SPARKDL_TPU_ALERTS": "1",
+        "SPARKDL_TPU_ALERT_CHECK_S": "0.1",
+        "SPARKDL_TPU_ALERT_MIN_STEPS": "3",
+        "SPARKDL_TPU_ALERT_WINDOW_S": "10",
+        "SPARKDL_TPU_ALERT_RSS_GROWTH_BYTES_PER_STEP":
+            str(LEAK_THRESHOLD),
+        "SPARKDL_TPU_CHAOS_LEAK_BYTES_PER_STEP": str(LEAK_PER_STEP),
+        "SPARKDL_TPU_CHAOS_LEAK_RANK": "1",
+    })
+
+    from sparkdl import HorovodRunner
+
+    scraper = Scraper(f"http://127.0.0.1:{port}")
+    scraper.start()
+    t0 = time.monotonic()
+    HorovodRunner(np=-2).run(_leaky_main, n_steps=48, step_s=0.05)
+    elapsed = time.monotonic() - t0
+    scraper.join(timeout=10)
+    print(f"gang finished in {elapsed:.1f}s")
+    if elapsed > DEADLINE_S:
+        fail(f"gang took {elapsed:.0f}s (deadline {DEADLINE_S}s)")
+
+    # 1. the injected leak tripped exactly host_rss_growth, on rank 1
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected one run dir under {out_dir}, found {run_dirs}")
+    run_dir = run_dirs[0]
+    alerts = json.load(open(os.path.join(run_dir, "alerts.json")))
+    fired = alerts.get("alerts") or []
+    rules = {a.get("rule") for a in fired}
+    if rules != {"host_rss_growth"}:
+        fail(f"expected exactly host_rss_growth, got {rules or 'none'}")
+    if [a.get("rank") for a in fired] != [1]:
+        fail(f"leak alert fired on ranks "
+             f"{[a.get('rank') for a in fired]}, injected on rank 1 "
+             "only (a clean rank must stay quiet)")
+    leak = fired[0]
+    detail = leak.get("detail") or {}
+    if detail.get("category") != "host_rss":
+        fail(f"leak detail names category {detail.get('category')!r}, "
+             "expected 'host_rss'")
+    if not detail.get("slope_bytes_per_step", 0) > LEAK_THRESHOLD:
+        fail(f"leak slope {detail.get('slope_bytes_per_step')} not "
+             f"above the {LEAK_THRESHOLD} B/step bound")
+    prom = open(os.path.join(run_dir, "metrics.prom")).read()
+    if 'gang_alerts_total{rank="driver",rule="host_rss_growth"' \
+            not in prom:
+        fail("gang_alerts_total missing from metrics.prom")
+    trace = json.load(open(os.path.join(run_dir, "timeline.json")))
+    if not any(e.get("name") == "alert.host_rss_growth"
+               for e in trace["traceEvents"]):
+        fail("alert.host_rss_growth instant missing from the merged "
+             "timeline")
+    # the workers' mem gauges landed in the merged metrics
+    if "host_rss_bytes" not in prom:
+        fail("host_rss_bytes gauge missing from metrics.prom")
+
+    # 2. /statusz carried the per-rank memory panel mid-run
+    doc = scraper.memory_doc
+    if doc is None:
+        fail("/statusz never showed a memory panel with rss_bytes")
+    with open(os.path.join(out_dir, "statusz-mid-run.json"), "w") as f:
+        json.dump(doc, f, indent=2)
+    print("mid-run memory panel:",
+          json.dumps(doc.get("memory"), indent=2)[:600])
+
+    # 3. the doctor names the leaking category, artifact-only, exit 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run_dir],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    with open(os.path.join(out_dir, "doctor-leak-report.txt"), "w") as f:
+        f.write(proc.stdout + proc.stderr)
+    if proc.returncode != 0:
+        fail(f"doctor exited {proc.returncode} on the leaking run (a "
+             f"leak is not a hang/OOM):\n{proc.stdout}\n{proc.stderr}")
+    if "leak [host_rss_growth] rank 1: category 'host_rss'" \
+            not in proc.stdout:
+        fail(f"doctor did not name the leaking category:\n{proc.stdout}")
+
+    # 4. an induced allocation failure writes the forensic report and
+    #    flips the doctor's exit code
+    from sparkdl_tpu.observe import mem
+
+    oom_dir = os.path.join(out_dir, "oom-run")
+    os.makedirs(oom_dir, exist_ok=True)
+    mem.register_tree("params", 64 * 1024 * 1024)
+    mem.note_budget("train_step", {"temp_size_in_bytes": 32 * 1024})
+    try:
+        with mem.oom_guard(phase="step", run_dir=oom_dir):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating "
+                "2500000000 bytes (induced by ci/mem_smoke.py)")
+    except RuntimeError:
+        pass
+    report_path = os.path.join(oom_dir, "oom_report.json")
+    if not os.path.exists(report_path):
+        fail("oom_guard wrote no oom_report.json")
+    report = json.load(open(report_path))
+    if report.get("categories", {}).get("params") != 64 * 1024 * 1024:
+        fail(f"oom report category table wrong: {report.get('categories')}")
+    if not report.get("hints"):
+        fail("oom report carries no actionable hints")
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", oom_dir],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    with open(os.path.join(out_dir, "doctor-oom-report.txt"), "w") as f:
+        f.write(proc.stdout + proc.stderr)
+    if proc.returncode != 1:
+        fail(f"doctor exited {proc.returncode} on the OOM dir, "
+             f"expected 1:\n{proc.stdout}\n{proc.stderr}")
+    if "verdict: OOM" not in proc.stdout:
+        fail(f"doctor missed the OOM verdict:\n{proc.stdout}")
+    if "RESOURCE_EXHAUSTED" not in proc.stdout:
+        fail(f"doctor did not render the failure:\n{proc.stdout}")
+
+    print("MEM SMOKE PASSED: the injected leak tripped exactly "
+          "host_rss_growth on rank 1 with category host_rss, /statusz "
+          "showed the memory panel mid-run, the doctor named the "
+          "category from artifacts alone, and the induced OOM produced "
+          "a hinted report plus a nonzero doctor verdict.")
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,13 @@ def worker_io(rank, local_log_path=None):
 
         heartbeat = HeartbeatSender(client, rank)
         heartbeat.start()
+        # Memory accounting: the low-rate sampler keeps the beacon's
+        # mem field fresh (category gauges, host RSS, unattributed
+        # residual) — behind the same latch, so no env means no
+        # thread (sparkdl_tpu.observe.mem).
+        from sparkdl_tpu.observe import mem
+
+        mem.maybe_start_sampler()
         observe.instant("worker.start", cat="worker", rank=rank)
     _set_parent_death_signal()
     local_log = (
@@ -171,6 +178,9 @@ def worker_io(rank, local_log_path=None):
             if observe.enabled():
                 if heartbeat is not None:
                     heartbeat.stop()
+                from sparkdl_tpu.observe import mem
+
+                mem.stop_sampler()
                 # Final flush BEFORE the BYE: the driver treats BYE as
                 # this rank's last word, and the tail of the timeline
                 # (checkpoint saves, the last step spans) must not

@@ -83,7 +83,8 @@ class _WorkerTelemetry:
     """The minimal engine-side telemetry adapter: implements exactly
     the hooks :class:`ContinuousBatchingEngine` calls behind its
     ``telemetry is not None`` test (``request_admitted`` /
-    ``decode_chunk`` / ``admission_deferred``), recording onto the
+    ``decode_chunk`` / ``request_pages`` / ``admission_deferred``),
+    recording onto the
     fleet's shared registry. This is how the fleet measures
     arrival→admission (queue wait) separately from
     admission→first-token (service) without the full per-request span
@@ -110,6 +111,14 @@ class _WorkerTelemetry:
         self._metrics.histogram(
             "engine_batch_utilization", buckets=_UTIL_BUCKETS
         ).observe(active / max(1, n_slots))
+
+    def request_pages(self, rid, pages):
+        # per-request KV-page footprint (ISSUE 18): the fleet-wide
+        # histogram sizes the shared pool posture across replicas
+        self._metrics.histogram(
+            "engine_request_kv_pages",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                     256.0, 512.0)).observe(pages)
 
     def admission_deferred(self, reason):
         self._metrics.counter(

@@ -388,6 +388,15 @@ class ContinuousBatchingEngine:
                                                      jnp.int32),
                                  **init_kw)
         self._cache = state["cache"]
+        # Categorized accounting (ISSUE 18): the KV cache/pool and the
+        # serving params are long-lived trees — register them so the
+        # mem sampler's category table attributes them instead of
+        # lumping them into 'unattributed'. No-ops with telemetry off.
+        from sparkdl_tpu.observe import mem as _mem_acct
+
+        _mem_acct.register_tree(
+            "kv_pages", lambda: _mem_acct.tree_nbytes(self._cache))
+        _mem_acct.register_tree("params", params)
         self._pos = jnp.zeros((self.n_slots,), jnp.int32)
         self._token = jnp.zeros((self.n_slots,), jnp.int32)
         self._adapter_ids = np.zeros((self.n_slots,), np.int32)
@@ -638,6 +647,12 @@ class ContinuousBatchingEngine:
             # queue wait ends HERE — the engine is about to spend
             # prefill compute on this request
             self.telemetry.request_admitted(rid)
+            # per-request worst-case KV footprint (ISSUE 18) — the
+            # getattr guard keeps older three-hook telemetry adapters
+            # (tests stub them) working unchanged
+            hook = getattr(self.telemetry, "request_pages", None)
+            if hook is not None:
+                hook(rid, total_pages)
         own = [self._free_pages.pop() for _ in range(need)]
         self._slot_pages[slot_idx] = own
         self._tables[slot_idx] = 0
@@ -918,12 +933,25 @@ class ContinuousBatchingEngine:
         if self._queue and self.page_size and not self._prefilling:
             need = self._pages_needed(self._queue[0])
             if need > len(self._free_pages):
-                raise RuntimeError(
+                err = RuntimeError(
                     f"paged pool exhausted: request needs "
                     f"{need} fresh pages, pool has "
                     f"{len(self._free_pages)} free and nothing "
                     "left to drain — raise n_pages"
                 )
+                # Engine-admission OOM forensics (ISSUE 18): the pool
+                # shortfall is the serving tier's allocation failure —
+                # write the report before the engine thread unwinds.
+                # Inert without SPARKDL_TPU_TELEMETRY_DIR.
+                from sparkdl_tpu.observe import mem
+
+                mem.write_oom_report(
+                    "admission", err,
+                    extra={"pages_needed": need,
+                           "pages_free": len(self._free_pages),
+                           "n_pages": self.cfg.n_pages,
+                           "page_size": self.page_size})
+                raise err
 
     def _accept_tokens(self, slot_idx, tokens, logprobs):
         """Append generated tokens to a slot (streaming callback, eos

@@ -273,7 +273,8 @@ def _reset_for_tests():
     _registry = Registry()
     _timeline = Timeline()
     _sink = None
-    from sparkdl_tpu.observe import health, perf
+    from sparkdl_tpu.observe import health, mem, perf
 
     health._reset_for_tests()
     perf._reset_for_tests()
+    mem._reset_for_tests()

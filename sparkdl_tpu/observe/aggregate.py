@@ -414,6 +414,23 @@ class GangTelemetry:
                 f"flightrec-rank-{rank}.json",
                 json.dumps({"rank": rank, "events": tails[rank]}),
             ))
+        # OOM reports: workers write oom_report*.json into their job
+        # dir (the only directory a gang worker is guaranteed to own);
+        # copy them into the merged run dir where the doctor looks.
+        # Same never-fatal stance as flight-ring recovery.
+        import glob as _glob
+
+        for job_dir in job_dirs:
+            try:
+                reports = _glob.glob(os.path.join(job_dir, "oom_report*.json"))
+            except Exception:
+                continue
+            for src in sorted(reports):
+                try:
+                    with open(src) as f:
+                        files.append((os.path.basename(src), f.read()))
+                except Exception:
+                    continue
         if health:
             files.append(
                 (HEALTH_FILE, json.dumps({"attempts": health}, indent=2))

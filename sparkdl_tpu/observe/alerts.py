@@ -60,6 +60,23 @@ not a page storm):
     set — TTFT SLOs are workload-specific). With the chip-budget
     arbiter on (ISSUE 16), this firing is a demand signal: training
     yields chips to the fleet.
+``hbm_leak`` (critical)
+    A rank's device memory (the beacon's mem sample, ``hbm``) is
+    growing faster than ``SPARKDL_TPU_ALERT_HBM_LEAK_BYTES_PER_STEP``
+    bytes per unit progress — a robust slope (median of per-interval
+    slopes) over the rolling sample window, normalized by the rank's
+    own step/request progress so a fast rank and a slow rank leak at
+    the same *per-step* rate fire identically (dormant unless set).
+    The firing names the fastest-growing category from the beacon's
+    category table — what ``observe.doctor`` renders as the leak
+    suspect.
+``host_rss_growth`` (warning)
+    Same slope machinery over the beacon's host RSS sample — the
+    host-side leak detector (prefetch buffers, compile cache,
+    plain-Python leaks), threshold
+    ``SPARKDL_TPU_ALERT_RSS_GROWTH_BYTES_PER_STEP`` bytes per unit
+    progress (dormant unless set). Provable end-to-end on CPU CI via
+    the ``SPARKDL_TPU_CHAOS_LEAK_BYTES_PER_STEP`` injector.
 ``mfu_drop`` (warning)
     Any rank's live ``mfu`` gauge fell below
     ``SPARKDL_TPU_ALERT_MFU_MIN`` (dormant unless set).
@@ -101,6 +118,8 @@ QUEUE_GROWTH_ENV = "SPARKDL_TPU_ALERT_QUEUE_GROWTH"
 TTFT_P99_ENV = "SPARKDL_TPU_ALERT_TTFT_P99_S"
 HBM_FRAC_ENV = "SPARKDL_TPU_ALERT_HBM_FRAC"
 HEARTBEAT_GAP_FRAC_ENV = "SPARKDL_TPU_ALERT_HEARTBEAT_GAP_FRAC"
+HBM_LEAK_ENV = "SPARKDL_TPU_ALERT_HBM_LEAK_BYTES_PER_STEP"
+RSS_GROWTH_ENV = "SPARKDL_TPU_ALERT_RSS_GROWTH_BYTES_PER_STEP"
 
 DEFAULT_WINDOW_S = 60.0
 DEFAULT_CHECK_S = 5.0
@@ -130,6 +149,10 @@ RULES = (
      "beat age beyond the warn fraction of the stall window"),
     ("hbm_high_water", SEV_CRITICAL, "_check_hbm",
      "device HBM in use approaching the per-chip capacity budget"),
+    ("hbm_leak", SEV_CRITICAL, "_check_hbm_leak",
+     "device memory growing per unit progress beyond the bound"),
+    ("host_rss_growth", SEV_WARNING, "_check_rss_growth",
+     "host RSS growing per unit progress beyond the bound"),
     ("queue_depth_growth", SEV_WARNING, "_check_queue_growth",
      "server_queue_depth growing faster than the configured rate"),
     ("server_ttft", SEV_WARNING, "_check_server_ttft",
@@ -232,6 +255,8 @@ class AlertEngine:
         self.overlap_min = _env_float(env, OVERLAP_MIN_ENV, None)
         self.queue_growth = _env_float(env, QUEUE_GROWTH_ENV, None)
         self.ttft_p99_s = _env_float(env, TTFT_P99_ENV, None)
+        self.hbm_leak_bps = _env_float(env, HBM_LEAK_ENV, None)
+        self.rss_growth_bps = _env_float(env, RSS_GROWTH_ENV, None)
         # Baseline resolution order: explicit env seconds, committed
         # ledger record, self-calibration (the min rolling median the
         # run has shown, per rank).
@@ -247,6 +272,10 @@ class AlertEngine:
         self._fired = {}              # (rule, rank) -> record
         self._records = []
         self._queue_samples = collections.deque(maxlen=256)
+        # rank -> deque of (progress, hbm_bytes, rss_bytes, categories)
+        # fed from each poll's live beacon mem samples — the leak
+        # rules' rolling window (engine-owned, like _queue_samples).
+        self._mem_samples = {}
         self._next_check = 0.0
 
     # -- elastic world changes -----------------------------------------------
@@ -275,6 +304,10 @@ class AlertEngine:
         for latch in [k for k in self._fired
                       if isinstance(k[1], int) and k[1] >= num_workers]:
             del self._fired[latch]
+        # Leak windows for departed ranks are stale the same way: a
+        # relaunched rank k after a resize is a different workload.
+        for rank in [r for r in self._mem_samples if r >= num_workers]:
+            del self._mem_samples[rank]
 
     # -- baseline ------------------------------------------------------------
 
@@ -439,6 +472,110 @@ class AlertEngine:
                     "fraction": round(used / capacity, 4),
                     "threshold_fraction": self.hbm_frac,
                 }))
+        return out
+
+    def _ingest_mem_samples(self, ctx):
+        """Fold each live rank's beacon mem sample into its rolling
+        leak window. Idempotent within a poll (an unchanged
+        progress/value pair is not re-appended), so both leak rules
+        may call it without double-counting — and samples accumulate
+        even while the thresholds are unset, like the queue rule's."""
+        for rank, info in ctx["live"].items():
+            if not isinstance(rank, int):
+                continue
+            mem = info.get("mem") or {}
+            progress = info.get("progress")
+            if not mem or not isinstance(progress, (int, float)):
+                continue
+            cats = dict(mem.get("categories") or {})
+            if mem.get("unattributed") is not None:
+                cats["unattributed"] = mem["unattributed"]
+            sample = (float(progress), mem.get("hbm"), mem.get("rss"),
+                      cats)
+            dq = self._mem_samples.setdefault(
+                rank, collections.deque(maxlen=256))
+            if dq and dq[-1][:3] == sample[:3]:
+                continue
+            dq.append(sample)
+
+    @staticmethod
+    def _robust_slope(points):
+        """Median of per-interval slopes over ``[(progress, value)]``
+        — one outlier sample (a GC pause, a transient spike) cannot
+        fake or mask a trend the way a first-vs-last delta could.
+        None when fewer than two progress-advancing intervals carry
+        values."""
+        slopes = [
+            (v1 - v0) / (p1 - p0)
+            for (p0, v0), (p1, v1) in zip(points, points[1:])
+            if p1 > p0 and v0 is not None and v1 is not None
+        ]
+        return _median(slopes) if len(slopes) >= 2 else None
+
+    def _mem_growth_firings(self, ctx, idx, threshold):
+        """Shared leak evaluator body: per-rank robust slope of sample
+        field ``idx`` (1=hbm, 2=rss) per unit progress, fired against
+        ``threshold`` bytes/step. Returns (rank, slope, span, window)
+        tuples for ranks over the bound."""
+        self._ingest_mem_samples(ctx)
+        if threshold is None:
+            return []
+        out = []
+        for rank, dq in sorted(self._mem_samples.items()):
+            window = list(dq)
+            if len(window) < 2:
+                continue
+            span = window[-1][0] - window[0][0]
+            if span < self.min_steps:
+                continue   # not enough progress to call a trend
+            slope = self._robust_slope(
+                [(s[0], s[idx]) for s in window])
+            if slope is not None and slope > threshold:
+                out.append((rank, slope, span, window))
+        return out
+
+    @staticmethod
+    def _growing_category(window, span):
+        """The fastest-growing category over the window — the leak
+        suspect the doctor names. Falls back to 'unattributed' when
+        the table is empty (nothing registered = everything leaks
+        outside the trees)."""
+        first, last = window[0][3] or {}, window[-1][3] or {}
+        best, best_rate = None, 0.0
+        for cat in set(first) | set(last):
+            rate = (last.get(cat, 0) - first.get(cat, 0)) / max(span, 1)
+            if rate > best_rate:
+                best, best_rate = cat, rate
+        return best or "unattributed"
+
+    def _check_hbm_leak(self, ctx):
+        out = []
+        for rank, slope, span, window in self._mem_growth_firings(
+                ctx, 1, self.hbm_leak_bps):
+            out.append((rank, {
+                "rank": rank,
+                "slope_bytes_per_step": round(slope, 1),
+                "threshold_bytes_per_step": self.hbm_leak_bps,
+                "progress_span": round(span, 1),
+                "category": self._growing_category(window, span),
+                "hbm_bytes": window[-1][1],
+            }))
+        return out
+
+    def _check_rss_growth(self, ctx):
+        out = []
+        for rank, slope, span, window in self._mem_growth_firings(
+                ctx, 2, self.rss_growth_bps):
+            out.append((rank, {
+                "rank": rank,
+                "slope_bytes_per_step": round(slope, 1),
+                "threshold_bytes_per_step": self.rss_growth_bps,
+                "progress_span": round(span, 1),
+                # host-side growth has no HBM category table; the
+                # category the doctor names IS the host heap
+                "category": "host_rss",
+                "rss_bytes": window[-1][2],
+            }))
         return out
 
     def _check_queue_growth(self, ctx):

@@ -333,6 +333,89 @@ def _diagnose_serving(events, by_rank, top_n=5):
     }
 
 
+def _diagnose_memory(run_dir, by_rank, health):
+    """The memory section (or None when the run carried no memory
+    evidence): per-rank category tables from the ``mem_bytes{category}``
+    / ``host_rss_bytes`` gauges (the beacon samples in ``health.json``
+    fill gaps for a rank whose final flush never landed), the leak
+    alerts' named categories from ``alerts.json``, and every
+    ``oom_report*.json`` the mem ``oom_guard`` wrote — the OOM verdict
+    that flips the doctor's exit code. Artifact-only, like the rest."""
+    ranks = {}
+    for rank_label, series in by_rank.items():
+        if not rank_label.isdigit():
+            continue
+        entry = {}
+        rss = _gauge(series, "host_rss_bytes")
+        if rss is not None:
+            entry["rss_bytes"] = rss
+        cats = {}
+        for (name, labels), v in series.get("gauges", {}).items():
+            if name == "mem_bytes":
+                cat = dict(labels).get("category")
+                if cat is not None:
+                    cats[cat] = v
+        if cats:
+            entry["categories"] = cats
+        if entry:
+            ranks[rank_label] = entry
+    for att in (health or {}).get("attempts", []):
+        for rank_s, info in (att.get("ranks") or {}).items():
+            mem = info.get("mem") or {}
+            if not mem:
+                continue
+            entry = ranks.setdefault(str(rank_s), {})
+            if entry.get("rss_bytes") is None \
+                    and mem.get("rss") is not None:
+                entry["rss_bytes"] = mem["rss"]
+            cats = entry.setdefault("categories", {})
+            for cat, v in (mem.get("categories") or {}).items():
+                cats.setdefault(cat, v)
+            if mem.get("unattributed") is not None:
+                cats.setdefault("unattributed", mem["unattributed"])
+            if not cats:
+                del entry["categories"]
+
+    leaks = []
+    alerts = _load_json(os.path.join(run_dir, "alerts.json")) or {}
+    for rec in alerts.get("alerts") or ():
+        if rec.get("rule") in ("hbm_leak", "host_rss_growth"):
+            d = rec.get("detail") or {}
+            leaks.append({
+                "rule": rec.get("rule"),
+                "rank": rec.get("rank"),
+                "category": d.get("category"),
+                "slope_bytes_per_step": d.get("slope_bytes_per_step"),
+                "threshold_bytes_per_step":
+                    d.get("threshold_bytes_per_step"),
+            })
+
+    ooms = []
+    for p in sorted(glob.glob(os.path.join(run_dir,
+                                           "oom_report*.json"))):
+        rep = _load_json(p)
+        if not isinstance(rep, dict):
+            continue
+        ooms.append({
+            "file": os.path.basename(p),
+            "phase": rep.get("phase"),
+            "rank": rep.get("rank"),
+            "error": str(rep.get("error") or "")[:400],
+            "categories": rep.get("categories") or {},
+            "unattributed": rep.get("unattributed"),
+            "host_rss_bytes": rep.get("host_rss_bytes"),
+            "device": rep.get("device") or {},
+            "static_budget_bytes": rep.get("static_budget_bytes"),
+            "largest_buffers": (rep.get("largest_buffers") or [])[:3],
+            "hints": rep.get("hints") or [],
+        })
+
+    if not ranks and not leaks and not ooms:
+        return None
+    return {"ranks": ranks, "leaks": leaks, "oom_reports": ooms,
+            "oom": bool(ooms)}
+
+
 def diagnose(run_dir):
     """Build the structured diagnosis dict for one run dir, or None
     when the directory holds no recognizable artifacts."""
@@ -352,8 +435,11 @@ def diagnose(run_dir):
     for evs in recover_job_dir(run_dir).values():
         ring_events.extend(e for e in evs if isinstance(e, dict))
     fixit = _diagnose_fixit(run_dir)
+    # An OOM-killed process may have written NOTHING but its report —
+    # a dir holding only oom_report.json still diagnoses.
+    has_oom = bool(glob.glob(os.path.join(run_dir, "oom_report*.json")))
     if (timeline is None and metrics is None and health is None
-            and not ring_events and fixit is None):
+            and not ring_events and fixit is None and not has_oom):
         return None
 
     events = [e for e in (timeline or {}).get("traceEvents", ())
@@ -467,6 +553,7 @@ def diagnose(run_dir):
         "recovered_from_flight_recorder": bool(ring_fresh),
         "flight_recorder_recovered_events": len(ring_fresh),
         "serving": _diagnose_serving(events, by_rank),
+        "memory": _diagnose_memory(run_dir, by_rank, health),
         "alerts": _diagnose_alerts(run_dir),
         "elastic": _diagnose_elastic(run_dir),
         "perf": _diagnose_perf(run_dir, events, by_rank),
@@ -494,6 +581,9 @@ def render_text(diag):
         lines.append(f"verdict: HANG ({diag['verdict']})")
     else:
         lines.append("verdict: no hang found")
+    if (diag.get("memory") or {}).get("oom"):
+        n = len(diag["memory"]["oom_reports"])
+        lines.append(f"verdict: OOM ({n} report(s))")
     stalled = set(diag["stalled_ranks"])
     silent = set(diag["silent_ranks"])
     for rank_s, info in diag["ranks"].items():
@@ -672,6 +762,59 @@ def render_text(diag):
                         f"{k}={'ok' if v else 'FAIL'}"
                         for k, v in sorted(proofs.items())) + "]")
                 lines.append(line)
+    memory = diag.get("memory")
+    if memory:
+        lines.append("memory:")
+        for rank_s, entry in sorted(memory["ranks"].items()):
+            cats = entry.get("categories") or {}
+            parts = ", ".join(
+                f"{c} {_fmt_bytes(v)}"
+                for c, v in sorted(cats.items(),
+                                   key=lambda kv: -(kv[1] or 0)))
+            line = f"  rank {rank_s}:"
+            if entry.get("rss_bytes") is not None:
+                line += f" host RSS {_fmt_bytes(entry['rss_bytes'])}"
+            if parts:
+                line += f"; {parts}"
+            lines.append(line)
+        for leak in memory["leaks"]:
+            where = (f" rank {leak['rank']}"
+                     if leak.get("rank") is not None else "")
+            line = (f"  leak [{leak['rule']}]{where}: category "
+                    f"'{leak.get('category')}' growing "
+                    f"{_fmt_bytes(leak.get('slope_bytes_per_step'))}"
+                    "/step")
+            thr = leak.get("threshold_bytes_per_step")
+            if thr is not None:
+                line += f" (threshold {_fmt_bytes(thr)}/step)"
+            lines.append(line)
+        for oom in memory["oom_reports"]:
+            where = (f" rank {oom['rank']}"
+                     if oom.get("rank") is not None else "")
+            lines.append(f"  OOM [{oom.get('phase')}]{where} "
+                         f"({oom['file']}): {oom.get('error')}")
+            cats = oom.get("categories") or {}
+            if cats:
+                lines.append("    categories at death: " + ", ".join(
+                    f"{c} {_fmt_bytes(v)}"
+                    for c, v in sorted(cats.items(),
+                                       key=lambda kv: -(kv[1] or 0))))
+            if oom.get("unattributed") is not None:
+                lines.append("    unattributed: "
+                             + _fmt_bytes(oom["unattributed"]))
+            peak = (oom.get("device") or {}).get("peak")
+            budget = oom.get("static_budget_bytes")
+            if peak is not None or budget is not None:
+                lines.append(
+                    f"    measured peak {_fmt_bytes(peak)} vs static "
+                    f"budget {_fmt_bytes(budget)}")
+            for buf in oom.get("largest_buffers") or ():
+                lines.append(
+                    f"    largest: {buf.get('count')} x "
+                    f"{buf.get('shape')} {buf.get('dtype')} = "
+                    f"{_fmt_bytes(buf.get('bytes'))}")
+            for hint in oom.get("hints") or ():
+                lines.append(f"    hint: {hint}")
     srv = diag.get("serving")
     if srv:
         codes = ", ".join(f"{c}: {n}" for c, n in
@@ -707,7 +850,8 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m sparkdl_tpu.observe.doctor",
         description="Postmortem diagnosis over a merged telemetry run "
-                    "dir; exits nonzero when a hang verdict is found.",
+                    "dir; exits nonzero when a hang or OOM verdict is "
+                    "found.",
     )
     parser.add_argument("run_dir", help="a run-* dir under "
                         "SPARKDL_TPU_TELEMETRY_DIR (or a copy of one)")
@@ -725,7 +869,8 @@ def main(argv=None):
         print(json.dumps(diag, indent=2, sort_keys=True))
     else:
         print(render_text(diag))
-    return 1 if diag["hang"] else 0
+    oom = (diag.get("memory") or {}).get("oom")
+    return 1 if (diag["hang"] or oom) else 0
 
 
 if __name__ == "__main__":

@@ -136,6 +136,8 @@ def heartbeat_payload(rank):
     process registry so the next telemetry flush exports them."""
     from sparkdl_tpu import observe
 
+    from sparkdl_tpu.observe import mem as _mem
+
     snap = progress_snapshot()
     registry = observe.metrics()
     if snap["step"] is not None:
@@ -148,6 +150,11 @@ def heartbeat_payload(rank):
         "progress": snap["progress"],
         "collective": snap["collective"],
         "hbm": hbm,
+        # categorized accounting (ISSUE 18): the latest mem sample
+        # rides the guaranteed beacon so the driver's live_state /
+        # statusz / leak rules see per-category bytes without any
+        # extra transport. {} until the sampler takes its first sample.
+        "mem": _mem.beacon_sample(),
         "ts": time.time(),
     }
 
@@ -253,6 +260,7 @@ class HangDetector:
             info["step"] = payload.get("step")
             info["collective"] = payload.get("collective")
             info["hbm"] = payload.get("hbm") or {}
+            info["mem"] = payload.get("mem") or {}
             if isinstance(progress, (int, float)):
                 if info["progress"] is None or progress > info["progress"]:
                     if info["progress"] is not None and rank in self._stalled:
@@ -428,7 +436,7 @@ class HangDetector:
                         "state": ("silent" if rank in self._silent
                                   else "unseen"),
                         "step": None, "progress": None,
-                        "collective": None, "hbm": {},
+                        "collective": None, "hbm": {}, "mem": {},
                         "beat_age_s": None,
                     }
                     continue
@@ -441,6 +449,7 @@ class HangDetector:
                     "progress": info.get("progress"),
                     "collective": info.get("collective"),
                     "hbm": dict(info.get("hbm") or {}),
+                    "mem": dict(info.get("mem") or {}),
                     "beat_age_s": round(now - info["last_beat"], 3),
                 }
         return out
@@ -461,6 +470,7 @@ class HangDetector:
                         "progress": info.get("progress"),
                         "collective": info.get("collective"),
                         "hbm": info.get("hbm") or {},
+                        "mem": info.get("mem") or {},
                     }
                     for r, info in self._ranks.items()
                 },

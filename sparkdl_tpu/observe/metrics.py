@@ -282,8 +282,13 @@ def _reset_build_info_for_tests():
 def merge_snapshots(snaps):
     """Merge cumulative snapshots from successive incarnations of ONE
     logical process (e.g. a rank across supervised relaunches):
-    counters and histogram bucket counts sum, gauges take the value
-    from the newest snapshot (by its ``ts``)."""
+    counters and histogram bucket counts sum; gauges take the value
+    from the newest snapshot BY ITS ``ts`` STAMP, not by position in
+    ``snaps`` — callers recover incarnation files in directory-listing
+    order, so a restarted rank whose first attempt flushed last must
+    still lose to the newer attempt's gauge (ties go to the later
+    argument). A gauge is a statement about "now"; only the newest
+    "now" survives the merge."""
     out = {"ts": 0.0, "counters": [], "gauges": [], "histograms": []}
     counters = {}
     gauges = {}   # key -> (ts, value)

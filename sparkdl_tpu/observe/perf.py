@@ -443,6 +443,11 @@ def register_step_cost(name, executable):
         return None
     cost = jax_compat.cost_analysis(executable)
     mem = jax_compat.memory_analysis(executable)
+    if mem:
+        # static budget for the OOM report's measured-vs-predicted line
+        from sparkdl_tpu.observe import mem as mem_acct
+
+        mem_acct.note_budget(name, mem)
     entry = {
         "flops": (cost or {}).get("flops"),
         "bytes_accessed": (cost or {}).get("bytes_accessed"),

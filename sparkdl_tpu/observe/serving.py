@@ -56,6 +56,11 @@ so they ride the existing ``GET /metrics``):
 - ``engine_active_slots`` / ``engine_slot_occupancy`` — slots busy at
   the last chunk (count and fraction);
 - ``engine_kv_page_occupancy`` — used pages / pool (paged cache only);
+- ``engine_kv_page_occupancy_high_water`` — the worst occupancy any
+  chunk has seen (paged cache only) — pool sizing reads this, not the
+  instantaneous gauge;
+- ``engine_request_kv_pages`` — per-request worst-case KV-page
+  footprint histogram, observed at admission (paged cache only);
 - ``engine_decode_chunks_total`` / ``engine_decode_tokens_total`` —
   decode chunks and tokens dispatched (dispatched minus accepted
   ``server_generated_tokens_total`` = host-discarded overshoot);
@@ -106,6 +111,11 @@ RATE_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 # and the _sum/_count average is exact regardless of layout.
 UTIL_BUCKETS = tuple(i / 16 for i in range(1, 17))
 
+# Per-request KV-page footprints: power-of-two buckets span a one-page
+# toy prompt through a long-context pool-filler.
+PAGE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                512.0)
+
 
 class ServingTelemetry:
     """One serving run's request instrumentation + artifact writer.
@@ -146,6 +156,7 @@ class ServingTelemetry:
         except ValueError:
             self.max_events = DEFAULT_MAX_EVENTS
         self._dropped = 0
+        self._pool_high_water = 0.0   # worst KV-page occupancy seen
         self._write_lock = threading.Lock()  # writer thread vs close()
         self._writer = None
         self._writer_stop = None
@@ -293,8 +304,23 @@ class ServingTelemetry:
         if n_pages:
             # page 0 is the reserved junk dump, never allocatable
             pool = max(1, n_pages - 1)
+            occupancy = (pool - free_pages) / pool
             self.registry.gauge("engine_kv_page_occupancy").set(
-                (pool - free_pages) / pool)
+                occupancy)
+            if occupancy > self._pool_high_water:
+                self._pool_high_water = occupancy
+                self.registry.gauge(
+                    "engine_kv_page_occupancy_high_water"
+                ).set(occupancy)
+
+    def request_pages(self, rid, pages):
+        """Admission computed this request's worst-case KV-page
+        footprint (prompt + max_new, shared prefix pages excluded) —
+        the per-request memory cost distribution pool sizing is done
+        against."""
+        self.registry.histogram(
+            "engine_request_kv_pages", buckets=PAGE_BUCKETS
+        ).observe(pages)
 
     def admission_deferred(self, reason):
         """Capacity admission control kicked in (request left queued,

@@ -289,6 +289,21 @@ class StatuszServer:
             }
             doc["gang"]["stall_s"] = self._detector.stall_s
             doc["gang"]["hang_verdict"] = self._detector.hang_verdict
+            # Per-rank memory panel (ISSUE 18): the beacon mem samples
+            # lifted into their own top-level table so mission control
+            # reads categories/RSS without digging through ranks.
+            memory = {}
+            for r, info in doc["ranks"].items():
+                mem = info.get("mem") or {}
+                if mem:
+                    memory[r] = {
+                        "rss_bytes": mem.get("rss"),
+                        "hbm_bytes": mem.get("hbm"),
+                        "unattributed_bytes": mem.get("unattributed"),
+                        "categories": mem.get("categories") or {},
+                    }
+            if memory:
+                doc["memory"] = memory
         if self._alerts is not None:
             doc["alerts"] = {
                 "enabled": True,

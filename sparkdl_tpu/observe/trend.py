@@ -87,7 +87,7 @@ def build_trend(entries, baselines=None, only=None, last=None):
     by_metric = {}
     for idx, entry in enumerate(entries):
         for name, m in (entry.get("metrics") or {}).items():
-            if only and name not in only:
+            if only and not any(s in name for s in only):
                 continue
             if not isinstance(m, dict):
                 m = {"value": m}
@@ -194,7 +194,9 @@ def main(argv=None):
                         help="committed baseline JSON (repeatable; "
                         "default: BASELINE.json + serve_baseline.json)")
     parser.add_argument("--metric", action="append", default=None,
-                        help="restrict to this metric (repeatable)")
+                        help="restrict to metrics containing this "
+                        "substring (repeatable; e.g. --metric serve "
+                        "matches every serving series)")
     parser.add_argument("--last", type=int, default=None,
                         help="only the newest N records per metric")
     parser.add_argument("--format", choices=("text", "json"),

@@ -213,6 +213,15 @@ class CompiledStepCache:
         # accounting (alias_size reads 0) — without the stored stats a
         # warm-started bench would overstate its own peak.
         self.last_memory_stats = None
+        # Device-side program footprint of every executable this
+        # instance served (generated_code_size_in_bytes per
+        # fingerprint), exposed as the "compile_cache" accounting
+        # category — no-op without the telemetry latch.
+        self._code_bytes = {}
+        from sparkdl_tpu.observe import mem as mem_acct
+
+        mem_acct.register_tree(
+            "compile_cache", lambda: sum(self._code_bytes.values()))
 
     def _entry_path(self, fingerprint):
         return os.path.join(self.cache_dir, f"aot-{fingerprint}.bin")
@@ -353,6 +362,7 @@ class CompiledStepCache:
                 "(fingerprint %s)", name, dt, fp[:12],
             )
             self._register_cost(name, compiled, lowered)
+            self._note_code_size(fp)
             return compiled
         self.misses += 1
         with observe.span("compile", cat="compile", fn=name,
@@ -387,7 +397,17 @@ class CompiledStepCache:
                         seconds=round(dt, 4))
         self._write(path, fp, compiled)
         self._register_cost(name, compiled, lowered)
+        self._note_code_size(fp)
         return compiled
+
+    def _note_code_size(self, fingerprint):
+        """Fold this executable's program size into the
+        "compile_cache" accounting category (its generated code lives
+        in device memory for as long as the executable does)."""
+        size = (self.last_memory_stats or {}).get(
+            "generated_code_size_in_bytes")
+        if size:
+            self._code_bytes[fingerprint] = int(size)
 
     @staticmethod
     def _register_cost(name, compiled, lowered):

@@ -135,8 +135,14 @@ def instrument_step(step_fn, name="train_step"):
         health.note_step(state["calls"])
         phase = "compile" if state["calls"] == 0 else "execute"
         t0 = time.perf_counter()
-        with observe.span(name, cat="train", step=state["calls"],
-                          phase=phase):
+        from sparkdl_tpu.observe import mem
+
+        # OOM forensics (ISSUE 18): an allocation failure inside the
+        # step writes oom_report.json (category table, sample tail,
+        # hints) before the exception unwinds the worker.
+        with mem.oom_guard(phase="step"), \
+                observe.span(name, cat="train", step=state["calls"],
+                             phase=phase):
             out = step_fn(*args, **kwargs)
         dt = time.perf_counter() - t0
         state["calls"] += 1

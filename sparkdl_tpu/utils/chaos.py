@@ -55,6 +55,15 @@ per-gang env — or a test's monkeypatch before launch — scopes them):
 - ``SPARKDL_TPU_CHAOS_MUTE_HEARTBEAT``: rank whose heartbeat
   beacons stop while the process stays alive — exercises the
   detector's *silent* verdict (beats lost without a process death).
+- ``SPARKDL_TPU_CHAOS_LEAK_BYTES_PER_STEP``: bytes of host memory
+  deliberately leaked by ``chaos_step`` on EVERY step (no ONCE
+  gating — a leak is a trend, not an event), held in a module-level
+  list so RSS grows at a known per-step slope. This is the
+  end-to-end proof harness for the mem-doctor leak rules
+  (``host_rss_growth`` / ``hbm_leak``, ISSUE 18): inject → sampler
+  sees RSS grow → alert fires → doctor names the category.
+- ``SPARKDL_TPU_CHAOS_LEAK_RANK``: rank that leaks (default: all
+  ranks).
 """
 
 import os
@@ -75,6 +84,13 @@ CP_DROP_ENV = _PREFIX + "CP_DROP"
 STALL_STEP_ENV = _PREFIX + "STALL_STEP"
 STALL_STEP_RANK_ENV = _PREFIX + "STALL_STEP_RANK"
 MUTE_HEARTBEAT_ENV = _PREFIX + "MUTE_HEARTBEAT"
+LEAK_BYTES_PER_STEP_ENV = _PREFIX + "LEAK_BYTES_PER_STEP"
+LEAK_RANK_ENV = _PREFIX + "LEAK_RANK"
+
+# The injected leak: one bytearray per step, never released. Written
+# (not just reserved) so the kernel actually backs the pages and VmRSS
+# moves — a reserved-but-untouched mapping leaks nothing measurable.
+_leaked = []
 
 # Lazily-latched per process: gangs ship chaos env at spawn, so one
 # check at first hook call suffices and the common (chaos-off) path
@@ -92,6 +108,7 @@ def _chaos_active():
 def _reset_cache_for_tests():
     global _active
     _active = None
+    del _leaked[:]
 
 
 def _rank():
@@ -170,6 +187,15 @@ def chaos_step(step):
     the configured injection point. No-op without chaos env."""
     if not _chaos_active():
         return
+    leak = os.environ.get(LEAK_BYTES_PER_STEP_ENV)
+    if leak is not None:
+        leak_rank = os.environ.get(LEAK_RANK_ENV)
+        if leak_rank is None or int(leak_rank) == _rank():
+            n = int(leak)
+            if n > 0:
+                buf = bytearray(n)
+                buf[::4096] = b"\x01" * len(buf[::4096])  # touch pages
+                _leaked.append(buf)
     stall_step = os.environ.get(STALL_STEP_ENV)
     if (stall_step is not None
             and int(stall_step) == int(step)

@@ -65,6 +65,24 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     hostq = queue_mod.Queue(maxsize=max(1, depth))
     stop = threading.Event()
 
+    # Host bytes sitting in the producer queue, exposed as the
+    # "host_prefetch" accounting category. Single-writer counters
+    # (producer bumps "in", consumer bumps "out") plus a FIFO of
+    # per-batch sizes — queue order IS the dequeue order, so the
+    # consumer charges off exactly what the producer charged on.
+    # All of it latch-gated: telemetry off pays nothing per batch.
+    mem_sizes = collections.deque()
+    mem_acct = {"in": 0, "out": 0}
+    if observe.enabled():
+        from sparkdl_tpu.observe import mem as _mem
+
+        _mem.register_tree(
+            "host_prefetch",
+            lambda: max(0, mem_acct["in"] - mem_acct["out"]))
+        _batch_nbytes = _mem.tree_nbytes
+    else:
+        _batch_nbytes = None
+
     def produce():
         def put(msg):
             # bounded-blocking put that stays responsive to close():
@@ -79,6 +97,10 @@ def prefetch_to_device(iterator, size=2, sharding=None):
 
         try:
             for batch in iterator:
+                if _batch_nbytes is not None:
+                    nb = _batch_nbytes(batch)
+                    mem_sizes.append(nb)
+                    mem_acct["in"] += nb
                 if not put((_ITEM, batch)):
                     return
             put((_END, None))
@@ -101,10 +123,13 @@ def prefetch_to_device(iterator, size=2, sharding=None):
         elif kind == _ERR:
             state["live"] = False
             state["err"] = val
-        elif sharding is None:
-            devq.append(jax.device_put(val))
         else:
-            devq.append(jax.device_put(val, sharding))
+            if _batch_nbytes is not None and mem_sizes:
+                mem_acct["out"] += mem_sizes.popleft()
+            if sharding is None:
+                devq.append(jax.device_put(val))
+            else:
+                devq.append(jax.device_put(val, sharding))
 
     def close():
         stop.set()

@@ -364,6 +364,17 @@ def _build():
         k("SPARKDL_TPU_NATIVE_LOGS", "bool", None, "observe",
           "native control-plane log transport toggle"),
 
+        # -- memory accounting (ISSUE 18) ---------------------------
+        k("SPARKDL_TPU_MEM_SAMPLE_S", "float", "2.0", "observe",
+          "memory sampler cadence (s): HBM stats + host RSS + "
+          "per-category gauges"),
+        k("SPARKDL_TPU_MEM_TOP_BUFFERS", "int", "8", "observe",
+          "rows kept in the (shape, dtype)-aggregated largest-live-"
+          "buffer table of samples and OOM reports"),
+        k("SPARKDL_TPU_MEM_SAMPLES", "int", "64", "observe",
+          "in-process rolling memory sample tail length (feeds OOM "
+          "reports and beacons)"),
+
         # -- live status & alerts (ISSUE 14) ------------------------
         k("SPARKDL_TPU_STATUSZ_PORT", "int", None, "observe",
           "driver-side live status HTTP port (GET /metrics, "
@@ -398,6 +409,14 @@ def _build():
           "server_ttft alert bound: fleet p99 time-to-first-token "
           "seconds, estimated from histogram buckets (dormant unless "
           "set)"),
+        k("SPARKDL_TPU_ALERT_HBM_LEAK_BYTES_PER_STEP", "float", None,
+          "observe", "hbm_leak alert bound: robust per-rank HBM "
+          "growth slope in bytes per unit of progress (dormant "
+          "unless set)"),
+        k("SPARKDL_TPU_ALERT_RSS_GROWTH_BYTES_PER_STEP", "float",
+          None, "observe", "host_rss_growth alert bound: robust "
+          "per-rank host RSS growth slope in bytes per unit of "
+          "progress (dormant unless set)"),
 
         # -- compile cache ------------------------------------------
         k("SPARKDL_TPU_COMPILE_CACHE_DIR", "path", None, "compile",
@@ -440,6 +459,11 @@ def _build():
           "suppress a rank's heartbeats"),
         k("SPARKDL_TPU_CHAOS_ONCE_FILE", "path", None, "chaos",
           "fire-once latch file for injections"),
+        k("SPARKDL_TPU_CHAOS_LEAK_BYTES_PER_STEP", "int", None,
+          "chaos", "host bytes deliberately leaked per step (proves "
+          "the leak alert + doctor end to end)"),
+        k("SPARKDL_TPU_CHAOS_LEAK_RANK", "int", None, "chaos",
+          "rank that leaks (unset = every rank)"),
     ]
     reg = {}
     for knob in knobs:

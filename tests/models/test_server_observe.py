@@ -230,6 +230,34 @@ def test_zero_overhead_latch_on_serving_path(monkeypatch):
         observe._reset_for_tests()
 
 
+def test_request_pages_histogram_and_pool_high_water(telemetry_dir):
+    """ISSUE 18 serving surfaces: per-request KV-page footprints land
+    in the ``engine_request_kv_pages`` histogram and the pool's worst
+    occupancy STICKS in ``engine_kv_page_occupancy_high_water`` (the
+    instantaneous gauge relaxes, the high water never does)."""
+    from sparkdl_tpu.observe.metrics import Registry
+    from sparkdl_tpu.observe.serving import ServingTelemetry
+
+    reg = Registry()
+    rt = ServingTelemetry(reg)
+    try:
+        rt.request_pages(0, 3)
+        rt.request_pages(1, 40)
+        # occupancy 6/8 then 2/8: high water must keep 0.75
+        rt.decode_chunk(2, 4, 8, free_pages=2, n_pages=9)
+        rt.decode_chunk(1, 4, 8, free_pages=6, n_pages=9)
+    finally:
+        rt.close()
+    snap = reg.snapshot()
+    (hist,) = [h for h in snap["histograms"]
+               if h["name"] == "engine_request_kv_pages"]
+    assert hist["count"] == 2 and hist["sum"] == 43
+    gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+    assert gauges["engine_kv_page_occupancy"] == pytest.approx(0.25)
+    assert gauges["engine_kv_page_occupancy_high_water"] == \
+        pytest.approx(0.75)
+
+
 @pytest.mark.slow
 def test_real_engine_telemetry_integration(telemetry_dir):
     """One real ContinuousBatchingEngine behind the frontend: the

@@ -304,6 +304,40 @@ def test_paged_pool_admission_control(setup):
     assert len(out[r1]) == 1 and len(out[r2]) == 1
 
 
+def test_pool_deadend_writes_oom_report(setup, tmp_path, monkeypatch):
+    """ISSUE 18 OOM forensics at the serving tier: the paged pool's
+    dead-end still raises, but with telemetry on it first writes an
+    ``oom_report.json`` whose category table names kv_pages and whose
+    hints include the pool-sizing fix."""
+    import json
+
+    from sparkdl_tpu import observe
+
+    cfg, model, params = setup
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    monkeypatch.delenv("SPARKDL_TPU_JOB_DIR", raising=False)
+    observe._reset_for_tests()
+    try:
+        rng = np.random.default_rng(9)
+        eng = ContinuousBatchingEngine(model, params, n_slots=1,
+                                       chunk=4, page_size=8, n_pages=2)
+        eng.submit(rng.integers(0, cfg.vocab_size, (20,)).astype(
+            np.int32), 20)
+        with pytest.raises(RuntimeError, match="paged pool exhausted"):
+            eng.run()
+        with open(tmp_path / "oom_report.json") as f:
+            report = json.load(f)
+        assert report["phase"] == "admission"
+        assert "paged pool exhausted" in report["error"]
+        # the engine registered its long-lived trees at construction
+        assert report["categories"]["kv_pages"] > 0
+        assert report["categories"]["params"] > 0
+        assert report["extra"]["n_pages"] == 2
+        assert any("n_pages" in h for h in report["hints"])
+    finally:
+        observe._reset_for_tests()
+
+
 @pytest.mark.parametrize("prefix_len", [11, 16])  # mid-page and aligned
 def test_paged_prefix_sharing_is_exact(setup, prefix_len):
     """Paged prefix sharing: full prefix pages referenced read-only by

@@ -193,6 +193,82 @@ def test_hbm_rule_dormant_without_capacity(monkeypatch):
     assert engine.poll() == []
 
 
+def _leak_live(progress, hbm, rss, cats=None):
+    return {0: {"state": "progressing", "beat_age_s": 1.0, "hbm": {},
+                "progress": progress,
+                "mem": {"hbm": hbm, "rss": rss,
+                        "categories": dict(cats or {}),
+                        "unattributed": 0}}}
+
+
+def test_leak_rules_dormant_without_thresholds():
+    det = _FakeDetector(stall_s=100, live={})
+    engine = AlertEngine(GangTelemetry(), detector=det, env=ENV)
+    for i in range(5):
+        det._live = _leak_live(i * 2.0, 10**6 * i, 10**6 * i)
+        assert engine.poll() == []
+
+
+def test_hbm_leak_fires_and_names_the_growing_category():
+    env = dict(ENV,
+               SPARKDL_TPU_ALERT_HBM_LEAK_BYTES_PER_STEP="1000")
+    det = _FakeDetector(stall_s=100, live={})
+    engine = AlertEngine(GangTelemetry(), detector=det, env=env)
+    for i in range(4):
+        det._live = _leak_live(
+            i * 2.0, 10**6 + 10**6 * i, 5 * 10**6,
+            cats={"params": 10**6, "kv_pages": 10**6 * i})
+        recs = engine.poll()
+        if recs:
+            break
+    (rec,) = recs
+    assert rec["rule"] == "hbm_leak"
+    assert rec["severity"] == "critical"
+    assert rec["rank"] == 0
+    assert rec["detail"]["slope_bytes_per_step"] > 1000
+    assert rec["detail"]["category"] == "kv_pages"
+    # latched: the sustained leak is ONE alert, not a storm
+    det._live = _leak_live(10.0, 10**8, 5 * 10**6)
+    assert engine.poll() == []
+
+
+def test_rss_growth_fires_as_host_rss_warning():
+    env = dict(ENV,
+               SPARKDL_TPU_ALERT_RSS_GROWTH_BYTES_PER_STEP="1000")
+    det = _FakeDetector(stall_s=100, live={})
+    engine = AlertEngine(GangTelemetry(), detector=det, env=env)
+    recs = []
+    for i in range(4):
+        det._live = _leak_live(i * 2.0, 10**6, 10**7 + 10**6 * i)
+        recs = engine.poll()
+        if recs:
+            break
+    (rec,) = recs
+    assert rec["rule"] == "host_rss_growth"
+    assert rec["severity"] == "warning"
+    assert rec["detail"]["category"] == "host_rss"
+    assert rec["detail"]["rss_bytes"] >= 10**7 + 2 * 10**6
+    assert rec["detail"]["slope_bytes_per_step"] == pytest.approx(
+        5 * 10**5)
+
+
+def test_leak_slope_is_robust_to_one_spike():
+    """One transient allocation burst (a GC pause, a resharding copy)
+    must not fake a leak: the median-of-interval-slopes estimator
+    ignores a single outlier where first-vs-last would fire."""
+    env = dict(ENV,
+               SPARKDL_TPU_ALERT_HBM_LEAK_BYTES_PER_STEP="1000",
+               # judge only once the window holds enough intervals for
+               # the median to drown the spike
+               SPARKDL_TPU_ALERT_MIN_STEPS="7")
+    det = _FakeDetector(stall_s=100, live={})
+    engine = AlertEngine(GangTelemetry(), detector=det, env=env)
+    flat = [10**6, 10**6 + 10, 10**8, 10**6 + 20, 10**6 + 30]
+    for i, hbm in enumerate(flat):
+        det._live = _leak_live(i * 2.0, hbm, 10**6)
+        assert engine.poll() == []
+
+
 def test_queue_growth_sees_in_process_fleet():
     """The real deployment shape: a colocated FleetFrontend's queue
     depth is private to its own registry and never crosses the

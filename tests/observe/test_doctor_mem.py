@@ -1,0 +1,119 @@
+"""observe.doctor memory section (ISSUE 18): per-rank category tables
+from the gauges with health-beacon gap-fill, leak alerts naming the
+growing category, and the OOM verdict — report rendered, exit code
+flipped — all from a copied run dir alone."""
+
+import json
+
+import pytest
+
+from sparkdl_tpu.observe import doctor
+
+
+def _metrics_doc():
+    return {"generated_at": 0, "series": [{
+        "labels": {"rank": "0"},
+        "counters": [],
+        "gauges": [
+            {"name": "host_rss_bytes", "labels": {"rank": "0"},
+             "value": 3 * 10**8},
+            {"name": "mem_bytes",
+             "labels": {"rank": "0", "category": "params"},
+             "value": 2 * 10**8},
+            {"name": "mem_bytes",
+             "labels": {"rank": "0", "category": "unattributed"},
+             "value": 5 * 10**7},
+        ],
+    }]}
+
+
+def _oom_report(rank=0, phase="step"):
+    return {
+        "schema": "sparkdl_tpu.observe.mem/oom_report/1",
+        "ts": 0, "phase": phase, "rank": rank,
+        "error": "RuntimeError: RESOURCE_EXHAUSTED: 2.5G on 2.0G chip",
+        "host_rss_bytes": 4 * 10**8,
+        "device": {"hbm": 2 * 10**9, "peak": 25 * 10**8,
+                   "limit": 2 * 10**9, "live": 2 * 10**9},
+        "categories": {"params": 15 * 10**8, "kv_pages": 4 * 10**8},
+        "unattributed": 10**8,
+        "largest_buffers": [
+            {"shape": "(4096, 4096)", "dtype": "float32",
+             "count": 12, "bytes": 8 * 10**8}],
+        "static_budget_bytes": 18 * 10**8,
+        "sample_tail": [],
+        "hints": ["Undonated step buffers double params+opt_state at "
+                  "the peak: apply the fixer's donate_argnums patch."],
+    }
+
+
+@pytest.fixture
+def mem_run(tmp_path):
+    run = tmp_path / "run-9-0"
+    run.mkdir()
+    (run / "timeline.json").write_text(json.dumps({"traceEvents": []}))
+    (run / "metrics.json").write_text(json.dumps(_metrics_doc()))
+    (run / "health.json").write_text(json.dumps({"attempts": [{
+        "ranks": {"1": {"state": "progressing", "mem": {
+            "rss": 10**8, "categories": {"params": 9 * 10**7},
+            "unattributed": 10**6}}},
+    }]}))
+    (run / "alerts.json").write_text(json.dumps({"alerts": [{
+        "rule": "host_rss_growth", "severity": "warning", "rank": 0,
+        "detail": {"rank": 0, "category": "host_rss",
+                   "slope_bytes_per_step": 2 * 10**6,
+                   "threshold_bytes_per_step": 10**6}}]}))
+    return run
+
+
+def test_memory_section_tables_and_leaks(mem_run):
+    diag = doctor.diagnose(str(mem_run))
+    memory = diag["memory"]
+    # rank 0 from the gauges; rank 1 only ever beaconed (gap-fill)
+    assert memory["ranks"]["0"]["rss_bytes"] == 3 * 10**8
+    assert memory["ranks"]["0"]["categories"]["params"] == 2 * 10**8
+    assert memory["ranks"]["1"]["rss_bytes"] == 10**8
+    assert memory["ranks"]["1"]["categories"]["unattributed"] == 10**6
+    (leak,) = memory["leaks"]
+    assert leak["rule"] == "host_rss_growth"
+    assert leak["category"] == "host_rss"
+    assert memory["oom"] is False
+    text = doctor.render_text(diag)
+    assert "memory:" in text
+    assert "leak [host_rss_growth] rank 0: category 'host_rss'" in text
+    assert "verdict: OOM" not in text
+
+
+def test_oom_report_flips_verdict_and_exit_code(mem_run, capsys):
+    (mem_run / "oom_report.json").write_text(
+        json.dumps(_oom_report()))
+    diag = doctor.diagnose(str(mem_run))
+    memory = diag["memory"]
+    assert memory["oom"] is True
+    (oom,) = memory["oom_reports"]
+    assert oom["phase"] == "step" and oom["rank"] == 0
+    assert oom["categories"]["params"] == 15 * 10**8
+    assert oom["hints"]
+    assert doctor.main([str(mem_run)]) == 1
+    out = capsys.readouterr().out
+    assert "verdict: OOM (1 report(s))" in out
+    assert "RESOURCE_EXHAUSTED" in out
+    assert "donate_argnums" in out
+
+
+def test_clean_memory_run_exits_zero(mem_run, capsys):
+    assert doctor.main([str(mem_run)]) == 0
+
+
+def test_dir_with_only_oom_report_still_diagnoses(tmp_path, capsys):
+    """An OOM-killed gang may leave NOTHING but the report the guard
+    flushed on the way down — that dir must still produce a verdict,
+    not 'no telemetry artifacts'."""
+    run = tmp_path / "run-dead"
+    run.mkdir()
+    (run / "oom_report-rank-3.json").write_text(
+        json.dumps(_oom_report(rank=3, phase="admission")))
+    assert doctor.main([str(run)]) == 1
+    out = capsys.readouterr().out
+    assert "verdict: OOM" in out
+    assert "rank 3" in out

@@ -155,6 +155,8 @@ def test_control_plane_round_trip_in_real_gang(monkeypatch, tmp_path):
     result = HorovodRunner(np=-2).run(_instrumented_main, n_steps=3)
     assert result["telemetry_on"] is True
     assert "sparkdl-tpu-heartbeat" in result["threads"]
+    # ISSUE 18: the memory sampler rides the same worker lifecycle
+    assert "sparkdl-tpu-mem-sampler" in result["threads"]
 
     run_dirs = glob.glob(str(tmp_path / "run-*"))
     assert len(run_dirs) == 1, run_dirs
@@ -201,6 +203,9 @@ def test_gang_without_telemetry_writes_nothing(monkeypatch, tmp_path):
     # (ISSUE 5: "with SPARKDL_TPU_TELEMETRY_DIR unset, heartbeats
     # stay fully disabled")
     assert "sparkdl-tpu-heartbeat" not in result["threads"]
+    # ISSUE 18: the latch covers memory accounting the same way — no
+    # sampler thread exists anywhere in the gang without the env
+    assert "sparkdl-tpu-mem-sampler" not in result["threads"]
     # ...and the ISSUE 14 live tier: no statusz thread/socket on the
     # driver and none in the workers without the env
     assert not any(t.name.startswith("sparkdl-tpu-statusz")

@@ -1,0 +1,222 @@
+"""observe.mem (ISSUE 18 tentpole): the telemetry latch (no env = no
+sampler thread, no gauges, no reports), categorized accounting with the
+unattributed residual, the heartbeat beacon shape, the OOM guard's
+forensic report (hints included), and the aggregate-time recovery of a
+worker's job-dir report into the merged run dir."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe import mem
+from sparkdl_tpu.utils import jax_compat
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe():
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+def _mem_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name == "sparkdl-tpu-mem-sampler"]
+
+
+# -- the latch ----------------------------------------------------------------
+
+
+def test_latch_no_env_means_no_thread_no_gauges_no_reports(
+        monkeypatch, tmp_path):
+    monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+    observe._reset_for_tests()
+    assert mem.maybe_start_sampler() is None
+    assert _mem_threads() == []
+    assert mem.register_tree("params", 1024) is None
+    assert mem.sample_now() is None
+    assert mem.beacon_sample() == {}
+    # an OOM-looking failure still propagates, but writes NOTHING
+    with pytest.raises(RuntimeError):
+        with mem.oom_guard(phase="step", run_dir=str(tmp_path)):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_sampler_thread_starts_and_stops_behind_latch(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    t = mem.maybe_start_sampler(interval=30.0)
+    try:
+        assert t is not None and t.is_alive()
+        assert _mem_threads() == ["sparkdl-tpu-mem-sampler"]
+        # idempotent: a second start returns the live thread
+        assert mem.maybe_start_sampler(interval=30.0) is t
+        assert len(_mem_threads()) == 1
+    finally:
+        mem.stop_sampler()
+    assert _mem_threads() == []
+
+
+# -- categorized accounting ---------------------------------------------------
+
+
+def test_categories_and_unattributed_residual(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    assert mem.register_tree("params", 4000) == 4000
+    pool = {"n": 1000}
+    mem.register_tree("kv_pages", lambda: pool["n"])
+    monkeypatch.setattr(jax_compat, "device_memory_stats",
+                        lambda: {"bytes_in_use": 9000,
+                                 "peak_bytes_in_use": 12000,
+                                 "bytes_limit": 16000})
+    monkeypatch.setattr(jax_compat, "live_buffer_bytes", lambda: 9000)
+    sample = mem.sample_now()
+    assert sample["categories"] == {"params": 4000, "kv_pages": 1000}
+    assert sample["unattributed"] == 9000 - 5000
+    assert sample["hbm"] == 9000 and sample["peak"] == 12000
+    # callables re-evaluate per sample: the pool grew
+    pool["n"] = 3000
+    assert mem.sample_now()["categories"]["kv_pages"] == 3000
+    # the gauges landed in the process registry
+    snap = observe.metrics().snapshot()
+    gauges = {(g["name"], g["labels"].get("category")): g["value"]
+              for g in snap["gauges"]}
+    assert gauges[("mem_bytes", "params")] == 4000
+    assert gauges[("mem_bytes", "kv_pages")] == 3000
+    assert gauges[("mem_bytes", "unattributed")] == 2000
+    assert gauges[("host_rss_bytes", None)] > 0
+    # the beacon is the compact latest-sample view
+    beacon = mem.beacon_sample()
+    assert beacon["hbm"] == 9000
+    assert beacon["categories"]["kv_pages"] == 3000
+    assert beacon["unattributed"] == 2000
+
+
+def test_host_rss_reads_this_process(monkeypatch, tmp_path):
+    rss = mem.host_rss_bytes()
+    assert isinstance(rss, int) and rss > 1024 * 1024
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    mem.sample_now()
+    high = mem.host_rss_high_water_bytes()
+    assert isinstance(high, int) and high >= rss // 2
+
+
+def test_static_budget_sums_registered_analyses(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    assert mem.static_budget_bytes() is None
+    mem.note_budget("train_step", {
+        "argument_size_in_bytes": 100, "output_size_in_bytes": 100,
+        "temp_size_in_bytes": 50, "alias_size_in_bytes": 80})
+    mem.note_budget("eval_step", {"temp_size_in_bytes": 30})
+    assert mem.static_budget_bytes() == (250 - 80) + 30
+
+
+def test_tree_nbytes_duck_types_without_jax_trees():
+    class Buf:
+        nbytes = 256
+
+    assert mem.tree_nbytes(Buf()) == 256
+    assert mem.tree_nbytes(object()) == 0
+
+
+# -- OOM forensics ------------------------------------------------------------
+
+
+def test_is_oom_markers():
+    assert mem.is_oom(MemoryError())
+    assert mem.is_oom(RuntimeError("RESOURCE_EXHAUSTED: while running"))
+    assert mem.is_oom(RuntimeError(
+        "paged pool exhausted: request needs 4 pages"))
+    assert not mem.is_oom(ValueError("shape mismatch"))
+
+
+def test_oom_guard_writes_forensic_report(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("SPARKDL_TPU_RANK", "1")
+    observe._reset_for_tests()
+    mem.register_tree("params", 4000)
+    mem.note_budget("train_step", {"temp_size_in_bytes": 1000})
+    monkeypatch.setattr(jax_compat, "device_memory_stats",
+                        lambda: {"bytes_in_use": 9000,
+                                 "peak_bytes_in_use": 12000,
+                                 "bytes_limit": 16000})
+    monkeypatch.setattr(jax_compat, "live_buffer_bytes", lambda: 9000)
+    run_dir = tmp_path / "run"
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        with mem.oom_guard(phase="step", run_dir=str(run_dir),
+                           extra={"step": 7}):
+            raise RuntimeError("RESOURCE_EXHAUSTED: 2.5G on 2.0G chip")
+    with open(run_dir / "oom_report.json") as f:
+        report = json.load(f)
+    assert report["schema"] == mem.OOM_REPORT_SCHEMA
+    assert report["phase"] == "step" and report["rank"] == 1
+    assert "RESOURCE_EXHAUSTED" in report["error"]
+    assert report["categories"]["params"] == 4000
+    assert report["device"]["peak"] == 12000
+    assert report["static_budget_bytes"] == 1000
+    assert report["extra"] == {"step": 7}
+    # actionable hints: donation fixer + grouped reshard + budget excess
+    hints = " ".join(report["hints"])
+    assert "donate" in hints
+    assert "SPARKDL_TPU_RESHARD_GROUPED" in hints
+    assert "exceeds the static" in hints
+    assert len(report["sample_tail"]) >= 1
+
+
+def test_non_oom_exceptions_pass_without_report(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    with pytest.raises(ValueError):
+        with mem.oom_guard(phase="step", run_dir=str(tmp_path / "r")):
+            raise ValueError("not an allocation failure")
+    assert not (tmp_path / "r").exists()
+
+
+def test_admission_phase_names_kv_pool_hint(monkeypatch, tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    path = mem.write_oom_report(
+        "admission", RuntimeError("paged pool exhausted"),
+        run_dir=str(tmp_path))
+    with open(path) as f:
+        report = json.load(f)
+    assert "n_pages" in " ".join(report["hints"])
+
+
+def test_report_path_rank_suffix_on_collision(monkeypatch, tmp_path):
+    base = mem.oom_report_path(str(tmp_path), rank="0")
+    assert base.endswith("oom_report.json")
+    with open(base, "w") as f:
+        f.write("{}")
+    assert mem.oom_report_path(str(tmp_path), rank="1").endswith(
+        "oom_report-rank-1.json")
+
+
+def test_aggregate_recovers_job_dir_reports(monkeypatch, tmp_path):
+    """A gang worker writes its report into the JOB dir (the only dir
+    it owns); GangTelemetry.write must copy it into the merged run dir
+    where the doctor looks — the flight-ring recovery pattern."""
+    from sparkdl_tpu.observe.aggregate import GangTelemetry
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    (job_dir / "oom_report-rank-1.json").write_text(
+        json.dumps({"schema": mem.OOM_REPORT_SCHEMA, "rank": 1}))
+    out_dir = tmp_path / "run"
+    out_dir.mkdir()
+    gt = GangTelemetry()
+    gt.note_job_dir(str(job_dir))
+    paths = gt.write(str(out_dir))
+    assert "oom_report-rank-1.json" in paths
+    with open(paths["oom_report-rank-1.json"]) as f:
+        assert json.load(f)["rank"] == 1

@@ -142,6 +142,35 @@ def test_merge_snapshots_sums_counters_and_keeps_newest_gauge():
     assert h["sum"] == pytest.approx(2.5)
 
 
+def test_merge_snapshots_newest_gauge_wins_regardless_of_order():
+    """Simulated incarnations of one rank across relaunches: callers
+    recover snapshot files in directory-listing order, which need not
+    be incarnation order. The gauge winner is decided by each
+    snapshot's ``ts`` stamp, NOT by position in the argument list."""
+    incarnations = []
+    for attempt, (ts, rss) in enumerate([(100.0, 10), (200.0, 20),
+                                         (300.0, 30)]):
+        reg = Registry()
+        reg.counter("relaunches_total").inc()
+        reg.gauge("host_rss_bytes").set(rss)
+        snap = reg.snapshot()
+        snap["ts"] = ts
+        incarnations.append(snap)
+    newest_first = [incarnations[2], incarnations[0], incarnations[1]]
+    merged = merge_snapshots(newest_first)
+    assert merged["counters"] == [
+        {"name": "relaunches_total", "labels": {}, "value": 3}
+    ]
+    # attempt 3 (ts=300) wins even though it was passed FIRST
+    assert merged["gauges"] == [
+        {"name": "host_rss_bytes", "labels": {}, "value": 30}
+    ]
+    assert merged["ts"] == 300.0
+    # a ts tie goes to the later argument (stable for identical dumps)
+    tied = [dict(incarnations[0], ts=50.0), dict(incarnations[1], ts=50.0)]
+    assert merge_snapshots(tied)["gauges"][0]["value"] == 20
+
+
 def test_render_prometheus_with_rank_labels():
     reg = Registry()
     reg.counter("ops_total").inc(2)

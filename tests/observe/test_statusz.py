@@ -151,6 +151,39 @@ def test_statusz_endpoint_ranks_perf_and_supervisor():
         server.close()
 
 
+def test_statusz_memory_panel_from_beacon_samples():
+    """ISSUE 18: a rank whose heartbeat carries a mem beacon gets a
+    row in the top-level /statusz memory panel; ranks without samples
+    (and runs without any) add no panel at all."""
+    detector = HangDetector(2, stall_s=30)
+    detector.observe_beat(0, {"step": 5, "progress": 11, "hbm": {},
+                              "mem": {"rss": 3 * 10**8, "hbm": 10**9,
+                                      "unattributed": 10**7,
+                                      "categories": {
+                                          "params": 9 * 10**8}}})
+    server = StatuszServer(GangTelemetry(), detector=detector,
+                           num_workers=2).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        panel = doc["memory"]
+        assert list(panel) == ["0"]
+        assert panel["0"]["rss_bytes"] == 3 * 10**8
+        assert panel["0"]["hbm_bytes"] == 10**9
+        assert panel["0"]["categories"] == {"params": 9 * 10**8}
+        assert panel["0"]["unattributed_bytes"] == 10**7
+    finally:
+        server.close()
+    # no beacons anywhere -> no panel key
+    server = StatuszServer(GangTelemetry(),
+                           detector=HangDetector(1, stall_s=30),
+                           num_workers=1).start()
+    try:
+        doc = json.loads(_get(f"http://{server.address}/statusz"))
+        assert "memory" not in doc
+    finally:
+        server.close()
+
+
 def test_statusz_shows_attempt_world_sizes(monkeypatch):
     """ISSUE 15 satellite: an elastically shrunken gang is visible in
     mission control — the current attempt's world size next to the

@@ -103,6 +103,22 @@ def test_metric_filter_and_last():
     assert rows[0]["delta_vs_prev"] is None
 
 
+def test_metric_filter_matches_substring():
+    """--metric is a substring filter: one spelling selects a family
+    of series (every serve_* metric) without typing each full name."""
+    entries = [
+        _entry("a", 100.0, metric="serve_ttft_p99_seconds"),
+        _entry("b", 200.0, metric="serve_tokens_per_sec"),
+        _entry("c", 300.0, metric="cpu_proxy_tokens_per_sec"),
+    ]
+    trend = build_trend(entries, only={"serve_"})
+    assert sorted(trend["metrics"]) == [
+        "serve_tokens_per_sec", "serve_ttft_p99_seconds"]
+    # an exact full name still selects exactly that series
+    trend = build_trend(entries, only={"cpu_proxy_tokens_per_sec"})
+    assert list(trend["metrics"]) == ["cpu_proxy_tokens_per_sec"]
+
+
 def test_cli_json_contract(tmp_path, capsys):
     history = tmp_path / "history.jsonl"
     with open(history, "w") as f:

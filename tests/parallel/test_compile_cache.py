@@ -236,3 +236,30 @@ def test_aot_entries_pruned_beyond_cap(cache, monkeypatch):
     # the oldest synthetic entries went first; the real one survives
     assert cache._entry_path(cache.fingerprint(lowered)).endswith(
         tuple(names))
+
+
+def test_compile_cache_memory_category(monkeypatch, tmp_path):
+    """ISSUE 18: every executable the cache serves folds its
+    generated-code size into the ``compile_cache`` accounting
+    category. Tolerant of runtimes whose memory analysis omits
+    ``generated_code_size_in_bytes`` — the category then legitimately
+    reads 0."""
+    from sparkdl_tpu import observe
+    from sparkdl_tpu.observe import mem
+
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path / "tel"))
+    observe._reset_for_tests()
+    try:
+        c = CompiledStepCache(str(tmp_path / "aot"))
+        lowered, _ = _lowered_train_step()
+        c.load_or_compile(lowered)
+        cats = mem.sample_now()["categories"]
+        assert "compile_cache" in cats
+        size = (c.last_memory_stats or {}).get(
+            "generated_code_size_in_bytes")
+        if size:
+            assert cats["compile_cache"] == int(size)
+        else:
+            assert cats["compile_cache"] == 0
+    finally:
+        observe._reset_for_tests()

@@ -220,3 +220,33 @@ class TestBackgroundPrefetch:
             assert sum(e["dur"] for e in waits) > 20_000  # µs
         finally:
             observe._reset_for_tests()
+
+    def test_host_prefetch_memory_category(self, monkeypatch, tmp_path):
+        """ISSUE 18: bytes parked in the producer queue register as
+        the ``host_prefetch`` accounting category while in flight, and
+        drain back to zero once the consumer has charged every batch
+        off."""
+        from sparkdl_tpu import observe
+        from sparkdl_tpu.observe import mem
+        from sparkdl_tpu.utils.data import prefetch_to_device
+
+        monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+        observe._reset_for_tests()
+        try:
+            def gen():
+                for i in range(6):
+                    yield {"x": np.full((256,), i, np.float32)}
+
+            pf = prefetch_to_device(gen(), size=1)
+            try:
+                next(pf)
+                time.sleep(0.3)  # let the producer park batches
+                cats = mem.sample_now()["categories"]
+                assert cats.get("host_prefetch", 0) > 0
+                list(pf)  # drain the pipeline to the end
+                assert mem.sample_now()["categories"][
+                    "host_prefetch"] == 0
+            finally:
+                pf.close()
+        finally:
+            observe._reset_for_tests()
