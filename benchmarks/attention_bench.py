@@ -5,10 +5,12 @@ lengths, plus the ring-attention overlap-vs-serialized schedule pair
 for why).
 
 Every metric reports ``p50``/``p99`` over ``REPS`` timed invocations
-and the run appends ONE schema-versioned line to the PR 7 ledger
-(``benchmarks/results/history.jsonl``), so an attention/overlap win is
-a row ``python -m sparkdl_tpu.observe.compare`` can gate on — not a
-one-off stdout line.
+and the run appends schema-versioned lines to the PR 7 ledger
+(``benchmarks/results/history.jsonl``): the combined
+``attention_bench`` record, then a kernel-vs-fallback A/B pair
+(``attention_bench:fallback`` / ``attention_bench:kernel``, same
+metric names) so ``python -m sparkdl_tpu.observe.compare`` can gate
+the kernel claim directly — not a one-off stdout line.
 
 ``--tiny`` (or ``SPARKDL_TPU_BENCH_TINY=1``) shrinks shapes for smoke
 runs on deviceless hosts.
@@ -82,6 +84,55 @@ def kernel_section(seqs, tiny):
     return rows, metrics
 
 
+def ab_section(seqs, tiny, kernel_interpret=False):
+    """Kernel-vs-fallback A/B pair (ISSUE 19): the KERNEL leg runs
+    ``flash_attention`` as dispatched — the pallas kernel on TPU, the
+    XLA reference fallback on cpu, so the cpu pair proves the compare
+    gate's wiring (identical programs, rc=0 by construction) and the
+    TPU pair carries the real claim. The FALLBACK leg pins
+    ``attention_reference`` explicitly. Both legs land as separate
+    ledger records with the SAME metric names (``attn_ms_s{seq}``), so
+    ``observe.compare <history>@-2 <history>@-1`` gates kernel vs
+    fallback directly.
+
+    ``kernel_interpret`` (off-TPU only) forces the kernel leg through
+    the interpret-mode emulation instead of the dispatch fallback —
+    the autotuner's cpu search mode: tile knobs change the emulated
+    program, so a tile trial measures SOMETHING tile-shaped on a
+    deviceless host. Never the default: emulation timings must not
+    pollute the gated kernel-vs-fallback rows."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.ops._dispatch import use_pallas
+    from sparkdl_tpu.ops.attention import flash_attention
+    from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+    interpret = True if (kernel_interpret and not use_pallas()) else None
+    n_steps = 2 if interpret else 10
+    rng = np.random.RandomState(2)
+    rows, kernel_metrics, fallback_metrics = [], {}, {}
+    for s in seqs:
+        b = max(1, (1024 if tiny else 8192) // s)
+        h, d = (2, 32) if tiny else (8, 128)
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+        kern = timed(
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True, interpret=interpret),
+            q, n_steps=n_steps)
+        fall = timed(
+            lambda q_, k_, v_: attention_reference(
+                q_, k_, v_, causal=True),
+            q, n_steps=n_steps)
+        kernel_metrics[f"attn_ms_s{s}"] = kern
+        fallback_metrics[f"attn_ms_s{s}"] = fall
+        rows.append({
+            "seq": s,
+            "kernel_ms_p50": kern["p50"],
+            "fallback_ms_p50": fall["p50"],
+        })
+    return rows, kernel_metrics, fallback_metrics
+
+
 def ring_section(tiny):
     """Overlap-vs-serialized ring schedules on a (1, N)-device mesh —
     the before/after pair for the ISSUE 10 hop restructure. On a
@@ -135,6 +186,8 @@ def main():
             or os.environ.get("SPARKDL_TPU_BENCH_TINY", "") not in ("", "0"))
     from sparkdl_tpu.observe import perf
 
+    kernel_interpret = "--kernel-interpret" in sys.argv
+
     seqs = (256, 512) if tiny else (1024, 2048, 4096, 8192)
     rows, metrics = kernel_section(seqs, tiny)
     ring, ring_metrics = ring_section(tiny)
@@ -142,10 +195,26 @@ def main():
     record = perf.history_record(
         metrics, device_kind=perf.device_kind(), bench="attention_bench")
     history = perf.append_history(record)
+
+    # kernel-vs-fallback A/B pair: two records, same metric names,
+    # fallback first so `<history>@-2 <history>@-1` is fallback→kernel
+    ab_rows, kernel_metrics, fallback_metrics = ab_section(
+        seqs, tiny, kernel_interpret=kernel_interpret)
+    perf.append_history(perf.history_record(
+        fallback_metrics, device_kind=perf.device_kind(),
+        bench="attention_bench:fallback", extra={"kernel": "off"}))
+    perf.append_history(perf.history_record(
+        kernel_metrics, device_kind=perf.device_kind(),
+        bench="attention_bench:kernel",
+        extra={"kernel": "on",
+               "kernel_interpret": bool(kernel_interpret)}))
+
     print(json.dumps({
         "benchmark": "flash_attention_vs_xla",
         "tiny": tiny,
         "rows": rows,
+        "ab": ab_rows,
+        "kernel_interpret": kernel_interpret,
         "ring": ring,
         "history": history,
     }))

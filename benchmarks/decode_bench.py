@@ -89,6 +89,14 @@ def main():
     )
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
 
+    # kernel=on|off label: whether the pallas kernel tier is engaged
+    # for this record (observe.trend --metric can then render the
+    # kernel trajectory once hardware shows up; on cpu every dispatch
+    # resolves to the XLA fallback, so the label is "off")
+    from sparkdl_tpu.ops._dispatch import use_pallas
+
+    kernel_label = "on" if use_pallas() else "off"
+
     dense_fields = _rate_fields(measure(model, params, prompt, new, batch))
     tps = dense_fields["tokens_per_sec_p50"]
     print(json.dumps({
@@ -107,6 +115,7 @@ def main():
     tps_q = q_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_int8_tokens_per_sec",
+        "kernel": kernel_label,
         **q_fields,
         "unit": "tokens/sec",
         "batch": batch, "prompt_len": p_len, "new_tokens": new,
@@ -123,6 +132,7 @@ def main():
     tps_q4 = q4_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_int4_tokens_per_sec",
+        "kernel": kernel_label,
         **q4_fields,
         "unit": "tokens/sec",
         "batch": batch, "prompt_len": p_len, "new_tokens": new,
@@ -214,6 +224,7 @@ def main():
     tps_cb = cb_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_continuous_batching_tokens_per_sec",
+        "kernel": kernel_label,
         **cb_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "requests": len(reqs),
@@ -244,6 +255,7 @@ def main():
     sb_fields = _rate_fields(sb_rates)
     print(json.dumps({
         "metric": "llama_decode_spec_batching_tokens_per_sec",
+        "kernel": kernel_label,
         **sb_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "k": spec_k, "requests": len(reqs),
@@ -265,6 +277,8 @@ def main():
     tps_pg = pg_fields["tokens_per_sec_p50"]
     print(json.dumps({
         "metric": "llama_decode_paged_tokens_per_sec",
+        "kernel": ("on" if (use_pallas() and eng_p.cfg.paged_kernel != "off")
+                   else "off"),
         **pg_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
@@ -283,6 +297,7 @@ def main():
                                   paged_kernel="off"))[0])
     print(json.dumps({
         "metric": "llama_decode_paged_gather_tokens_per_sec",
+        "kernel": "off",
         **gt_fields,
         "unit": "tokens/sec",
         "n_slots": n_slots, "chunk": chunk, "page_size": page_size,
@@ -290,6 +305,62 @@ def main():
             gt_fields["tokens_per_sec_p50"] / tps_pg, 3),
         "platform": jax.devices()[0].platform,
     }), flush=True)
+
+    # Quant-matmul kernel A/B (ISSUE 19): the int8 engine with the
+    # dequant GEMMs pinned to the XLA lowering (quant_kernel="off")
+    # vs dispatched ("auto" — the pallas kernel on TPU, the identical
+    # XLA fallback on cpu). Both legs land in the PR 7 ledger with
+    # the SAME metric name, fallback first, so
+    # ``observe.compare <history>@-2 <history>@-1`` gates the kernel
+    # claim; on cpu the pair is identical programs and rc=0 proves
+    # the gate wiring.
+    from sparkdl_tpu.observe import perf
+
+    def build_quant_engine(seed, quant_kernel):
+        gen = np.random.default_rng(seed)
+        eng = ContinuousBatchingEngine(
+            model, params, n_slots=n_slots, chunk=chunk, quant="int8",
+            quant_kernel=quant_kernel)
+        for p, nt in reqs:
+            eng.submit(
+                gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32), nt
+            )
+        return eng
+
+    # Interleave the legs rep-by-rep (off, auto, off, auto, ...):
+    # back-to-back blocks would fold slow host drift into the delta,
+    # and >=5 samples per leg lets compare's rel-IQR noise threshold
+    # engage instead of the bare 5% floor.
+    for mode in ("off", "auto"):
+        build_quant_engine(1, mode).run()   # warm both programs
+    qk_samples = {"off": [], "auto": []}
+    for _ in range(5):
+        for mode in ("off", "auto"):
+            eng = build_quant_engine(1, mode)
+            t0 = time.perf_counter()
+            results = eng.run()
+            dt = time.perf_counter() - t0
+            total = sum(len(v) for v in results.values())
+            qk_samples[mode].append(total / dt)
+
+    for label, leg, mode in (("off", "fallback", "off"),
+                             ("on", "kernel", "auto")):
+        met = perf.sample_metric(qk_samples[mode], unit="tokens/sec",
+                                 higher_is_better=True)
+        perf.append_history(perf.history_record(
+            {"engine_int8_tokens_per_sec": met},
+            device_kind=perf.device_kind(),
+            bench=f"decode_bench:{leg}",
+            extra={"kernel": label, "quant_kernel": mode}))
+        print(json.dumps({
+            "metric": "llama_decode_int8_engine_tokens_per_sec",
+            "kernel": label,
+            "quant_kernel": mode,
+            **_rate_fields(qk_samples[mode]),
+            "unit": "tokens/sec",
+            "n_slots": n_slots, "chunk": chunk, "requests": len(reqs),
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""CI kernel-tier smoke (ISSUE 19): prove the pallas kernel tier's
+three contracts end to end on a deviceless runner, in minutes.
+
+1. **Equivalence oracles**: each kernel in interpret mode vs its
+   fallback lowering — int8/int4 quant matmul vs the XLA dequant
+   product (odd shapes included), flash attention vs the XLA
+   reference, paged attention vs the gather oracle at several
+   ``pages_per_block`` widenings. The same oracles run in tier-1;
+   here they gate the kernel step itself so a red kernel never
+   reaches the tuning or A/B stages below.
+2. **Tile autotune → committed profile → pre-flight**: a tiny 2-value
+   ``perf.autotune`` search over ``SPARKDL_TPU_FLASH_BLOCK_Q`` on the
+   attention bench (``--bench-arg --kernel-interpret``: on cpu the
+   kernel leg runs the interpret emulation, so tile choices change
+   the measured program). The emitted ``profiles/cpu/attention.json``
+   must load through the real loader and apply through
+   ``perf.profile.preflight_env`` — the exact function the launcher
+   calls per supervised attempt.
+3. **A/B ledger gate**: fresh ``attention_bench`` and ``decode_bench``
+   runs append kernel-vs-fallback record PAIRS (same metric names,
+   fallback first) to a private history; ``observe.compare @-2 @-1``
+   must exit 0 for BOTH pairs. On cpu the gated kernel leg is the
+   DISPATCH (which resolves to the XLA fallback), so rc=0 proves the
+   gate's wiring; on TPU the same pair carries the real kernel claim.
+
+Artifacts (profile, trial ledger, A/B history, compare verdicts) land
+in the dir the workflow uploads. Outside the time-boxed tier-1 pytest
+gate — its own workflow step, like the other smokes.
+
+Usage: ``python ci/kernel_smoke.py [artifacts_dir]``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIMEOUT_S = 2400
+TILE_KNOB = "SPARKDL_TPU_FLASH_BLOCK_Q"
+TILE_VALUES = ["128", "256"]
+
+
+def fail(msg):
+    print(f"KERNEL SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_equivalence_oracles():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparkdl_tpu.ops.attention import flash_attention
+    from sparkdl_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode,
+    )
+    from sparkdl_tpu.ops.pallas.quantized_matmul import (
+        _dequant_int4,
+        quantize_int4,
+        quantize_int8,
+        quantized_matmul,
+        quantized_matmul_int4,
+    )
+    from sparkdl_tpu.parallel.ring_attention import attention_reference
+
+    rng = np.random.RandomState(0)
+
+    # int8 quant matmul, odd shape
+    x = jnp.asarray(rng.randn(37, 96), jnp.float32)
+    w_q, s = quantize_int8(rng.randn(96, 130).astype(np.float32))
+    out = np.asarray(quantized_matmul(
+        x, jnp.asarray(w_q), jnp.asarray(s), mode="force_interpret"))
+    ref = np.asarray(x) @ (w_q.astype(np.float32) * s[None, :])
+    err = np.abs(out - ref).max()
+    if err > 1e-3:
+        fail(f"int8 kernel vs XLA dequant: max err {err}")
+    print(f"oracle int8 quant matmul: max err {err:.2e}")
+
+    # int4 quant matmul, grouped scales
+    x4 = jnp.asarray(rng.randn(33, 192), jnp.float32)
+    packed, s4 = quantize_int4(
+        rng.randn(192, 72).astype(np.float32), group=64)
+    out4 = np.asarray(quantized_matmul_int4(
+        x4, jnp.asarray(packed), jnp.asarray(s4), group=64,
+        mode="force_interpret"))
+    deq = _dequant_int4(jnp.asarray(packed), jnp.asarray(s4), 64)
+    ref4 = np.asarray(x4 @ deq)
+    err4 = np.abs(out4 - ref4).max()
+    if err4 > 1e-3:
+        fail(f"int4 kernel vs XLA dequant: max err {err4}")
+    print(f"oracle int4 quant matmul: max err {err4:.2e}")
+
+    # flash attention, asymmetric tiles on a non-multiple sequence
+    q = jnp.asarray(rng.randn(1, 200, 2, 16), jnp.float32)
+    outf = np.asarray(flash_attention(
+        q, q, q, causal=True, block_q=64, block_kv=128,
+        interpret=True))
+    reff = np.asarray(attention_reference(q, q, q, causal=True))
+    errf = np.abs(outf - reff).max()
+    if errf > 1e-4:
+        fail(f"flash kernel vs XLA reference: max err {errf}")
+    print(f"oracle flash attention: max err {errf:.2e}")
+
+    # paged attention, widened blocks, ragged lengths
+    b, hkv, d, page, ppr = 2, 2, 16, 8, 3
+    n_pages = b * ppr + 1
+    qd = jnp.asarray(rng.randn(b, hkv * 2, d), jnp.float32)
+    k_pool = jnp.asarray(
+        rng.randn(n_pages, page, hkv, d), jnp.float32)
+    v_pool = jnp.asarray(
+        rng.randn(n_pages, page, hkv, d), jnp.float32)
+    tables = jnp.asarray(
+        np.arange(1, n_pages).reshape(b, ppr).astype(np.int32))
+    lens = jnp.asarray([5, page * ppr], jnp.int32)
+    base = np.asarray(paged_attention_decode(
+        qd, k_pool, v_pool, tables, lens, pages_per_block=1,
+        interpret=True))
+    for ppb in (2, 3):
+        wide = np.asarray(paged_attention_decode(
+            qd, k_pool, v_pool, tables, lens, pages_per_block=ppb,
+            interpret=True))
+        errp = np.abs(wide - base).max()
+        if errp > 1e-5:
+            fail(f"paged kernel ppb={ppb} vs ppb=1: max err {errp}")
+    print("oracle paged attention: ppb widenings agree")
+
+
+def run_autotune(env, history, profile_path):
+    cmd = [sys.executable, "-m", "sparkdl_tpu.perf.autotune",
+           "--bench", "attention", "--tiny",
+           "--knob", TILE_KNOB,
+           "--values", f"{TILE_KNOB}={','.join(TILE_VALUES)}",
+           "--history", history, "--out", profile_path,
+           "--max-trials", str(1 + len(TILE_VALUES)),   # baseline + tiles
+           "--bench-arg=--kernel-interpret"]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=TIMEOUT_S, cwd=ROOT)
+    sys.stderr.write(proc.stderr[-4000:])
+    print(proc.stdout)
+    if proc.returncode != 0:
+        fail(f"autotune exited {proc.returncode}")
+
+    from sparkdl_tpu.perf import profile as prof
+
+    doc = prof.load_profile(profile_path)
+    if doc["status"] not in ("verified", "degraded"):
+        fail(f"unexpected profile status {doc['status']!r}")
+    print(f"profile: status={doc['status']} knobs={doc['knobs']}")
+    if doc["bench"] != "attention":
+        fail(f"profile bench {doc['bench']!r} != 'attention'")
+    return doc
+
+
+def check_preflight(doc, profile_path, env):
+    apply_env = dict(env)
+    apply_env["SPARKDL_TPU_PERF_PROFILE"] = profile_path
+    apply_env.pop(TILE_KNOB, None)
+    code = (
+        "import json, os\n"
+        "from sparkdl_tpu.perf.profile import preflight_env\n"
+        "print(json.dumps(preflight_env(os.environ)))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=apply_env,
+                         capture_output=True, text=True, timeout=120,
+                         cwd=ROOT)
+    if out.returncode != 0:
+        fail(f"preflight_env failed: {out.stderr[-1000:]}")
+    delta = json.loads(out.stdout.strip().splitlines()[-1])
+    print(f"launcher pre-flight applies: {delta}")
+    expected = doc["knobs"] if doc["status"] == "verified" else {}
+    if delta != expected:
+        fail(f"pre-flight delta {delta} != profile knobs {expected}")
+    return delta
+
+
+def run_ab_bench(script, env, history, out_json):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", script)],
+        env=env, capture_output=True, text=True, timeout=1200,
+        cwd=ROOT)
+    if proc.returncode != 0:
+        fail(f"{script} exited {proc.returncode}:\n"
+             f"{proc.stderr[-2000:]}")
+    with open(out_json, "w") as f:
+        f.write(proc.stdout)
+    # locate the bench's fallback/kernel pair: last two records
+    records = [json.loads(ln) for ln in open(history) if ln.strip()]
+    benches = [r.get("bench", "") for r in records]
+    stem = script.replace(".py", "")
+    want = [f"{stem}:fallback", f"{stem}:kernel"]
+    if benches[-2:] != want:
+        fail(f"{script}: last ledger benches {benches[-2:]} != {want}")
+    return len(records)
+
+
+def compare_pair(history, art, name, env):
+    cmp_out = os.path.join(art, f"compare-{name}.txt")
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.compare",
+         f"{history}@-2", f"{history}@-1"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=ROOT)
+    with open(cmp_out, "w") as f:
+        f.write(proc.stdout + proc.stderr)
+    print(proc.stdout.strip())
+    print(f"compare {name} fallback->kernel: rc={proc.returncode}")
+    if proc.returncode != 0:
+        fail(f"{name}: the kernel leg regressed its fallback leg — "
+             "the kernel-vs-fallback gate is red")
+
+
+def main():
+    art = os.path.abspath(
+        sys.argv[1] if len(sys.argv) > 1 else "kernel-artifacts")
+    os.makedirs(art, exist_ok=True)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["SPARKDL_TPU_BENCH_TINY"] = "1"
+    # a profile already on this runner must not contaminate the runs
+    env["SPARKDL_TPU_PERF_PROFILE"] = "off"
+
+    # 1. equivalence oracles (in-process; red kernel stops here)
+    check_equivalence_oracles()
+
+    # 2. tile search -> profile -> launcher pre-flight
+    trial_history = os.path.join(art, "trial-history.jsonl")
+    profile_path = os.path.join(art, "attention.json")
+    doc = run_autotune(env, trial_history, profile_path)
+    check_preflight(doc, profile_path, env)
+
+    # 3. A/B pairs into a private ledger, gated by observe.compare
+    ab_history = os.path.join(art, "ab-history.jsonl")
+    bench_env = dict(env)
+    bench_env["SPARKDL_TPU_PERF_HISTORY"] = ab_history
+    run_ab_bench("attention_bench.py", bench_env, ab_history,
+                 os.path.join(art, "attention-bench.json"))
+    compare_pair(ab_history, art, "attention", env)
+    run_ab_bench("decode_bench.py", bench_env, ab_history,
+                 os.path.join(art, "decode-bench.json"))
+    compare_pair(ab_history, art, "decode", env)
+
+    print("KERNEL SMOKE PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,13 @@ class LlamaConfig:
     # (K//group, N) layout is part of the serving config, not a
     # runtime inference.
     quant_group: int = 64
+    # Quant-matmul kernel mode for the int8/int4 GEMMs: "" defers to
+    # the SPARKDL_TPU_KERNEL_QUANT_MATMUL knob (read once at import of
+    # ops.pallas.quantized_matmul); "auto"/"off"/"force_interpret"
+    # mirror paged_kernel's vocabulary and, being config, are part of
+    # the jit cache key — the per-engine override tests and A/B
+    # benches flip THIS, never the env mid-process.
+    quant_kernel: str = ""
     # Multi-LoRA serving: > 0 stacks that many adapters on the frozen
     # base (params from models.lora.stack_lora_adapters); adapter_ids
     # passed to __call__ select one per batch row (S-LoRA-style
@@ -95,6 +102,12 @@ class LlamaConfig:
             raise ValueError(
                 f"paged_kernel must be 'auto', 'off', or "
                 f"'force_interpret', got {self.paged_kernel!r}"
+            )
+        if self.quant_kernel not in ("", "auto", "off",
+                                     "force_interpret"):
+            raise ValueError(
+                f"quant_kernel must be '', 'auto', 'off', or "
+                f"'force_interpret', got {self.quant_kernel!r}"
             )
         if self.multi_lora:
             attn_names = {"q_proj", "k_proj", "v_proj", "o_proj"}
@@ -163,8 +176,10 @@ def _dense(cfg, features, name):
 
         if cfg.quant == "int4":
             return QuantDense4(features=features, dtype=cfg.dtype,
-                               group=cfg.quant_group, name=name)
-        return QuantDense(features=features, dtype=cfg.dtype, name=name)
+                               group=cfg.quant_group,
+                               kernel=cfg.quant_kernel, name=name)
+        return QuantDense(features=features, dtype=cfg.dtype,
+                          kernel=cfg.quant_kernel, name=name)
     if cfg.lora_rank and name in cfg.lora_targets:
         return LoRADense(features=features, rank=cfg.lora_rank,
                          alpha=cfg.lora_alpha, dtype=cfg.dtype, name=name)
@@ -558,9 +573,11 @@ class Llama(nn.Module):
             if cfg.quant == "int4":
                 return QuantDense4(cfg.vocab_size, dtype=jnp.float32,
                                    group=cfg.quant_group,
+                                   kernel=cfg.quant_kernel,
                                    name="lm_head")(
                     x.astype(jnp.float32))
             return QuantDense(cfg.vocab_size, dtype=jnp.float32,
+                              kernel=cfg.quant_kernel,
                               name="lm_head")(x.astype(jnp.float32))
         # fp32 head: stability for the softmax/sampling path. (A bf16
         # head was measured on v5e and did NOT beat this — XLA already

@@ -43,6 +43,10 @@ class QuantDense(nn.Module):
 
     features: int
     dtype: jnp.dtype = jnp.bfloat16
+    # quant-matmul kernel mode ("" → SPARKDL_TPU_KERNEL_QUANT_MATMUL
+    # default); a module field so it is part of the traced program,
+    # threaded from LlamaConfig.quant_kernel
+    kernel: str = ""
 
     @nn.compact
     def __call__(self, x):
@@ -57,7 +61,7 @@ class QuantDense(nn.Module):
         )
         lead = x.shape[:-1]
         flat = x.reshape((-1, d_in)).astype(self.dtype)
-        out = quantized_matmul(flat, w_q, scale)
+        out = quantized_matmul(flat, w_q, scale, mode=self.kernel)
         return out.reshape(lead + (self.features,)).astype(self.dtype)
 
 
@@ -71,6 +75,7 @@ class QuantDense4(nn.Module):
     features: int
     dtype: jnp.dtype = jnp.bfloat16
     group: int = INT4_GROUP
+    kernel: str = ""
 
     @nn.compact
     def __call__(self, x):
@@ -92,7 +97,8 @@ class QuantDense4(nn.Module):
         # different-group tree needs the module (or
         # LlamaConfig.quant_group) constructed to match
         out = quantized_matmul_int4(
-            flat, w_q, scale, group=d_in // scale.shape[0])
+            flat, w_q, scale, group=d_in // scale.shape[0],
+            mode=self.kernel)
         return out.reshape(lead + (self.features,)).astype(self.dtype)
 
 

@@ -236,7 +236,8 @@ class ContinuousBatchingEngine:
     def __init__(self, model, params, *, n_slots=4, temperature=0.0,
                  eos_id=None, chunk=16, rng=None, mesh=None,
                  rules=None, page_size=0, n_pages=None,
-                 prefill_chunk=0, top_k=0, top_p=1.0, quant=""):
+                 prefill_chunk=0, top_k=0, top_p=1.0, quant="",
+                 quant_kernel=""):
         """``mesh`` enables tensor-parallel serving: params are placed
         per ``rules`` (default TRANSFORMER_RULES — Megatron column/row
         splits) and the KV cache is sharded over its kv-heads axis on
@@ -253,6 +254,14 @@ class ContinuousBatchingEngine:
         patterns as dense kernels (scales replicate). Pass a tree
         that is ALREADY quantized (cfg.quant set on ``model``) with
         ``quant=""`` — quantizing twice is refused.
+
+        ``quant_kernel`` routes the engine's dequant GEMMs: "" defers
+        to the ``SPARKDL_TPU_KERNEL_QUANT_MATMUL`` knob, "auto" runs
+        the fused pallas quant-matmul on TPU (XLA dequant elsewhere),
+        "off" pins the XLA lowering, "force_interpret" emulates the
+        kernel on any backend (the token-exactness oracle). Becomes
+        ``cfg.quant_kernel``, so it is part of the engine's program
+        cache key.
 
         ``page_size`` > 0 switches to a PAGED KV cache: one pooled
         physical store of ``n_pages`` pages shared by every slot
@@ -289,6 +298,13 @@ class ContinuousBatchingEngine:
             params = quantize_llama_params(
                 params, bits=8 if quant == "int8" else 4,
                 group=cfg.quant_group)
+        if quant_kernel:
+            if not cfg.quant:
+                raise ValueError(
+                    "quant_kernel routes the dequant GEMMs of a "
+                    "quantized engine; pass quant= (or a quantized "
+                    "model) with it")
+            cfg = dataclasses.replace(cfg, quant_kernel=quant_kernel)
         self.page_size = int(page_size)
         self.prefill_chunk = int(prefill_chunk)
         if self.prefill_chunk < 0:

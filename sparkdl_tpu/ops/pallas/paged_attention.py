@@ -26,17 +26,31 @@ written for THIS engine's pool layout (page-major (n_pages, page,
 Hkv, D), dump-page 0 for padding junk — see models/serving.py).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# Pages DMA'd per grid step (registered tunable knob). Read ONCE at
+# import: the kernel is traced inside jitted engine programs and env
+# vars are not part of the jit cache key — a mid-process flip must
+# never silently retune an already-traced program. The autotuner runs
+# each trial in a fresh subprocess, so trials see their own value;
+# per-call overrides go through ``pages_per_block=``.
+_DEFAULT_PAGES_PER_BLOCK = int(
+    os.environ.get("SPARKDL_TPU_PAGED_PAGES_PER_BLOCK", 1))
 
-def _kernel(page, rep, scale, n_pages_grid):
+
+def _kernel(page, rep, scale, n_grid, ppb):
     from jax.experimental import pallas as pl
 
-    def kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-               acc_ref, m_ref, l_ref):
+    def kernel(tables_ref, lens_ref, q_ref, *refs):
+        k_refs = refs[:ppb]
+        v_refs = refs[ppb:2 * ppb]
+        o_ref = refs[2 * ppb]
+        acc_ref, m_ref, l_ref = refs[2 * ppb + 1:]
         b = pl.program_id(0)
         j = pl.program_id(2)
 
@@ -48,34 +62,43 @@ def _kernel(page, rep, scale, n_pages_grid):
 
         length = lens_ref[b]
 
-        @pl.when(j * page < length)
-        def _attend():
-            q = q_ref[0, 0]                       # (rep, D)
-            k = k_ref[0, :, 0, :]                 # (page, D)
-            v = v_ref[0, :, 0, :]                 # (page, D)
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                             # (rep, page)
-            pos = j * page + jax.lax.broadcasted_iota(
-                jnp.int32, (1, page), 1)
-            s = jnp.where(pos < length, s, NEG_INF)
-            m_prev = m_ref[...]                   # (rep, 1)
-            l_prev = l_ref[...]
-            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new)                # (rep, page)
-            l_ref[...] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
-            m_ref[...] = m_new
-            acc_ref[...] = (
-                acc_ref[...] * alpha
-                + jax.lax.dot_general(
-                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )
+        # unrolled over the ppb page tiles of this grid step; each
+        # logical page jj masks itself against the row length (jj*page
+        # < length also implies jj < max_pages, so the clamped index
+        # map for the ragged final step can never let a duplicate
+        # page through)
+        for t in range(ppb):
+            jj = j * ppb + t
 
-        @pl.when(j == n_pages_grid - 1)
+            @pl.when(jj * page < length)
+            def _attend(t=t, jj=jj):
+                q = q_ref[0, 0]                       # (rep, D)
+                k = k_refs[t][0, :, 0, :]             # (page, D)
+                v = v_refs[t][0, :, 0, :]             # (page, D)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale                             # (rep, page)
+                pos = jj * page + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, page), 1)
+                s = jnp.where(pos < length, s, NEG_INF)
+                m_prev = m_ref[...]                   # (rep, 1)
+                l_prev = l_ref[...]
+                m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+                alpha = jnp.exp(m_prev - m_new)
+                p = jnp.exp(s - m_new)                # (rep, page)
+                l_ref[...] = (
+                    l_prev * alpha + p.sum(axis=-1, keepdims=True))
+                m_ref[...] = m_new
+                acc_ref[...] = (
+                    acc_ref[...] * alpha
+                    + jax.lax.dot_general(
+                        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+
+        @pl.when(j == n_grid - 1)
         def _finalize():
             o_ref[0, 0] = (
                 acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
@@ -85,7 +108,8 @@ def _kernel(page, rep, scale, n_pages_grid):
 
 
 def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
-                           scale=None, interpret=False):
+                           scale=None, interpret=False,
+                           pages_per_block=None):
     """One decode step over the paged pool.
 
     Args:
@@ -97,6 +121,14 @@ def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
       lens: (B,) int32 — number of visible tokens per row (the row's
         current position + 1: the just-written token attends to
         itself).
+      pages_per_block: K/V page tiles DMA'd per grid step (default:
+        the ``SPARKDL_TPU_PAGED_PAGES_PER_BLOCK`` knob). The pool's
+        pages are physically discontiguous, so a wider step is not one
+        bigger block — the pool rides the call once per tile, each
+        with its own table-indexed BlockSpec, and the kernel unrolls
+        over the tiles. More pages per step amortize grid overhead at
+        long contexts; the tradeoff is VMEM and is device-shaped,
+        which is why it is an autotuner target.
     Returns: (B, H, D) attention output in q.dtype.
     """
     from jax.experimental import pallas as pl
@@ -110,26 +142,40 @@ def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
     rep = h // hkv
     max_pages = tables.shape[1]
     scale = scale if scale is not None else d ** -0.5
+    ppb = int(pages_per_block or _DEFAULT_PAGES_PER_BLOCK)
+    ppb = max(1, min(ppb, max_pages))
 
     qg = q.reshape(b, hkv, rep, d)
     tables = tables.astype(jnp.int32)
     lens = lens.astype(jnp.int32)
 
-    grid = (b, hkv, max_pages)
+    n_grid = pl.cdiv(max_pages, ppb)
+    grid = (b, hkv, n_grid)
     # index maps see (grid..., *scalar_prefetch_refs)
     q_spec = pl.BlockSpec(
         (1, 1, rep, d), lambda bi, hi, j, tbl, ln: (bi, hi, 0, 0))
-    kv_spec = pl.BlockSpec(
-        (1, page, 1, d), lambda bi, hi, j, tbl, ln: (tbl[bi, j], 0, hi, 0))
+
+    def kv_spec(t):
+        # tile t of a grid step covers logical page j*ppb + t; the
+        # ragged final step clamps the table column (the duplicate
+        # reads it causes are masked in-kernel by the lens check)
+        def index(bi, hi, j, tbl, ln, t=t):
+            jj = jnp.minimum(j * ppb + t, max_pages - 1)
+            return (tbl[bi, jj], 0, hi, 0)
+
+        return pl.BlockSpec((1, page, 1, d), index)
+
     out_spec = pl.BlockSpec(
         (1, 1, rep, d), lambda bi, hi, j, tbl, ln: (bi, hi, 0, 0))
 
     out = pl.pallas_call(
-        _kernel(page, rep, scale, max_pages),
+        _kernel(page, rep, scale, n_grid, ppb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[q_spec, kv_spec, kv_spec],
+            in_specs=([q_spec]
+                      + [kv_spec(t) for t in range(ppb)]
+                      + [kv_spec(t) for t in range(ppb)]),
             out_specs=out_spec,
             scratch_shapes=[
                 pltpu.VMEM((rep, d), jnp.float32),   # acc
@@ -142,12 +188,13 @@ def paged_attention_decode(q, k_pool, v_pool, tables, lens, *,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(tables, lens, qg, k_pool, v_pool)
+    )(tables, lens, qg, *([k_pool] * ppb), *([v_pool] * ppb))
     return out.reshape(b, h, d)
 
 
 def paged_attention_decode_sharded(mesh, *, axis_name="model",
-                                   scale=None, interpret=False):
+                                   scale=None, interpret=False,
+                                   pages_per_block=None):
     """Bind the paged decode kernel to a TP mesh: the pool is sharded
     over its kv-head axis on ``axis_name`` (exactly the serving
     engine's cache sharding) and each device runs the kernel on its
@@ -163,7 +210,7 @@ def paged_attention_decode_sharded(mesh, *, axis_name="model",
     def local_fn(q, k_pool, v_pool, tables, lens):
         return paged_attention_decode(
             q, k_pool, v_pool, tables, lens, scale=scale,
-            interpret=interpret,
+            interpret=interpret, pages_per_block=pages_per_block,
         )
 
     from sparkdl_tpu.utils.jax_compat import shard_map

@@ -1,14 +1,26 @@
-"""Pallas TPU weight-only int8 matmul.
+"""Pallas TPU weight-only int8/int4 matmul with fused dequant.
 
-Serving-side kernel (pallas guide §Quantization): weights live in HBM
-as int8 with per-output-channel fp32 scales — half/quarter the bytes of
-bf16/fp32, which matters because decode-time matmuls are HBM-bandwidth
-bound. Each grid cell streams an int8 weight tile into VMEM, converts
-in-register, runs the MXU at fp32 accumulation, and applies the column
-scales on the way out.
+Serving-side kernels (pallas guide §Quantization): weights live in HBM
+as int8 (or nibble-packed int4) with fp32 scales — half/quarter the
+bytes of bf16/fp32, which matters because decode-time matmuls are
+HBM-bandwidth bound. The kernels are K-blocked: each (i, j) output
+tile owns an fp32 VMEM accumulator and streams quantized weight tiles
+through the MXU, dequantizing on the fly in the inner loop. Edge tiles
+of non-divisible M/N/K shapes are masked in-kernel (no host-side
+padding copies).
+
+Dispatch is governed by the ``SPARKDL_TPU_KERNEL_QUANT_MATMUL`` knob
+(``auto`` | ``off`` | ``force_interpret``): ``auto`` runs the kernel
+on TPU and the XLA dequant lowering elsewhere, ``off`` pins the XLA
+lowering everywhere, and ``force_interpret`` emulates the kernel on
+any backend (the CPU equivalence oracle). Shapes the kernel cannot
+serve degrade to the XLA lowering loudly (RuntimeWarning) — never to
+a wrong answer.
 """
 
 import functools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +29,41 @@ import numpy as np
 # int4 group size (rows per scale); defined up top because
 # quantize_params defaults to it
 INT4_GROUP = 64
+
+KERNEL_MODE_ENV = "SPARKDL_TPU_KERNEL_QUANT_MATMUL"
+KERNEL_MODES = ("auto", "off", "force_interpret")
+
+# Read ONCE at import: quantized_matmul runs under jit inside serving
+# programs and env vars are not part of the jit cache key — a
+# mid-process flip must never silently re-route already-traced
+# programs (same rationale as ops.attention's flash block defaults).
+# Per-call overrides go through the ``mode=`` argument, which callers
+# thread from LlamaConfig.quant_kernel (part of the program cache key).
+_DEFAULT_MODE = os.environ.get(KERNEL_MODE_ENV, "auto")
+
+
+def _kernel_plan(mode):
+    """Resolve a kernel mode to ``(use_kernel, interpret)``.
+
+    ``mode`` "" falls back to the import-time knob default."""
+    from sparkdl_tpu.ops._dispatch import use_pallas
+
+    mode = mode or _DEFAULT_MODE
+    if mode not in KERNEL_MODES:
+        raise ValueError(
+            f"unknown quant-matmul kernel mode {mode!r}; expected one "
+            f"of {KERNEL_MODES} (knob {KERNEL_MODE_ENV})")
+    if mode == "off":
+        return False, False
+    if mode == "force_interpret":
+        return True, True
+    return use_pallas(), False
+
+
+def _fallback_warn(reason):
+    warnings.warn(
+        f"quant-matmul kernel unsupported ({reason}); degrading to the "
+        "XLA dequant lowering", RuntimeWarning, stacklevel=3)
 
 
 def quantize_int8(w):
@@ -29,66 +76,96 @@ def quantize_int8(w):
     return w_q, scales
 
 
-def _qmm_kernel(x_ref, wq_ref, scale_ref, o_ref):
+def _qmm_kernel(nk, k, bk, x_ref, wq_ref, scale_ref, o_ref, acc_ref):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
     x = x_ref[:].astype(jnp.float32)
+    if k % bk:
+        # ragged final K tile: columns past K are block padding and may
+        # hold anything — zero them out of the contraction (the int8
+        # weight tile is finite garbage there, so masking x suffices)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(kpos < k, x, 0.0)
     w = wq_ref[:].astype(jnp.float32)
-    acc = jax.lax.dot_general(
+    acc_ref[:] += jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[:] = (acc * scale_ref[:][None, :]).astype(o_ref.dtype)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        # per-column scales factor out of the K-sum, so one multiply at
+        # the end is exact — the int8→fp32 dequant itself happens in
+        # the inner loop feeding the MXU
+        o_ref[:] = (acc_ref[:] * scale_ref[:][None, :]).astype(o_ref.dtype)
 
 
 def quantized_matmul_pallas(x, w_q, scales, *, block_m=128, block_n=128,
-                            interpret=False):
-    """x (M, K) @ dequant(w_q (K, N)) with per-column scales (N,)."""
+                            block_k=512, interpret=False):
+    """x (M, K) @ dequant(w_q (K, N)) with per-column scales (N,).
+
+    K-blocked with an fp32 VMEM accumulator; non-divisible M/N/K are
+    served by masked edge tiles, not host padding."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from sparkdl_tpu.ops._dispatch import block_for
     from sparkdl_tpu.utils.jax_compat import tpu_compiler_params
 
     m, k = x.shape
     _, n = w_q.shape
-    bm = min(block_m, m)
-    bn = min(block_n, n)
-    if m % bm or n % bn:
-        raise ValueError(f"shape ({m},{n}) not divisible by ({bm},{bn})")
-    grid = (m // bm, n // bn)
+    bm = block_for(m, tile=block_m)
+    bn = block_for(n, tile=block_n, floor=128)
+    bk = min(block_k, k)
+    grid = (pl.cdiv(m, bm), pl.cdiv(n, bn), pl.cdiv(k, bk))
     return pl.pallas_call(
-        _qmm_kernel,
+        functools.partial(_qmm_kernel, grid[2], k, bk),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((bn,), lambda i, j: (j,)),
+            pl.BlockSpec((bm, bk), lambda i, j, ki: (i, ki)),
+            pl.BlockSpec((bk, bn), lambda i, j, ki: (ki, j)),
+            pl.BlockSpec((bn,), lambda i, j, ki: (j,)),
         ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, ki: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("parallel", "parallel"),
+            # K innermost and sequential: the accumulator carries
+            # across k steps of one (i, j) tile
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(x, w_q, scales)
 
 
-def quantized_matmul(x, w_q, scales, *, interpret=None):
-    """Dispatch: pallas kernel on TPU (or interpret for tests), XLA
-    dequant-matmul elsewhere. M and N are padded to tile multiples and
-    sliced back."""
-    from sparkdl_tpu.ops._dispatch import block_for, pad_to, use_pallas
+def quantized_matmul(x, w_q, scales, *, interpret=None, mode=""):
+    """Dispatch: pallas kernel per the ``mode`` plan (see module
+    docstring), XLA dequant-matmul otherwise.
 
-    if interpret is None:
-        if not use_pallas():
-            w = w_q.astype(jnp.float32) * scales[None, :]
-            return (x.astype(jnp.float32) @ w).astype(x.dtype)
-        interpret = False
-    m, n = x.shape[0], w_q.shape[1]
-    bm, bn = block_for(m), block_for(n, floor=128)
-    x, pad_m = pad_to(x, bm, 0)
-    w_q, pad_n = pad_to(w_q, bn, 1)
-    scales, _ = pad_to(scales, bn, 0)
-    out = quantized_matmul_pallas(
-        x, w_q, scales, block_m=bm, block_n=bn, interpret=interpret
-    )
-    return out[:m, :n] if (pad_m or pad_n) else out
+    ``interpret`` is the legacy per-call override (True → interpreted
+    kernel, False → compiled kernel) and wins over ``mode``."""
+    if scales.shape != (w_q.shape[1],):
+        # caller bug, not a kernel limitation: the XLA lowering would
+        # broadcast a mis-shaped scale vector into a wrong-SHAPED
+        # product, so there is no correct lowering to degrade to
+        raise ValueError(
+            f"scales shape {scales.shape} does not match N={w_q.shape[1]}")
+    if interpret is not None:
+        use_kernel, interp = True, bool(interpret)
+    else:
+        use_kernel, interp = _kernel_plan(mode)
+    if use_kernel and w_q.dtype != jnp.int8:
+        _fallback_warn(f"w_q dtype {w_q.dtype} is not int8")
+        use_kernel = False
+    if not use_kernel:
+        w = w_q.astype(jnp.float32) * scales[None, :]
+        return (x.astype(jnp.float32) @ w).astype(x.dtype)
+    return quantized_matmul_pallas(x, w_q, scales, interpret=interp)
 
 
 # Dense layers quantized by default: every 2-D projection of the
@@ -211,65 +288,111 @@ def _dequant_int4(packed, scales, group):
     return w * jnp.repeat(scales, group, axis=0)
 
 
-def _q4mm_kernel(group, x_ref, wq_ref, scale_ref, o_ref):
+def _q4mm_kernel(group, nk, k, bk, x_ref, wq_ref, scale_ref, o_ref,
+                 acc_ref):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
     x = x_ref[:].astype(jnp.float32)
+    # on-the-fly group dequant of this K tile: unpack nibbles, apply
+    # the (bk // group, bn) scale slice row-repeated to (bk, bn)
     w = _dequant_int4(wq_ref[:], scale_ref[:], group)
-    o_ref[:] = jax.lax.dot_general(
+    if k % bk:
+        # ragged final K tile: block padding past K may hold anything
+        # (the padded fp32 scale rows in particular) — zero BOTH
+        # operands so no garbage (or NaN) reaches the accumulator
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(kpos < k, x, 0.0)
+        wpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, w.shape, 0)
+        w = jnp.where(wpos < k, w, 0.0)
+    acc_ref[:] += jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ).astype(o_ref.dtype)
+    )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
 def quantized_matmul_int4_pallas(x, packed, scales, *, group=INT4_GROUP,
-                                 block_m=128, block_n=128,
+                                 block_m=128, block_n=128, block_k=512,
                                  interpret=False):
-    """x (M, K) @ dequant(packed (K//2, N)) with (K//group, N) scales."""
+    """x (M, K) @ dequant(packed (K//2, N)) with (K//group, N) scales.
+
+    K-blocked like the int8 kernel; the K tile is rounded to a multiple
+    of the scale group (and of 2 for the nibble packing) so each grid
+    step sees whole groups."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from sparkdl_tpu.ops._dispatch import block_for
     from sparkdl_tpu.utils.jax_compat import tpu_compiler_params
 
     m, k = x.shape
     kh, n = packed.shape
     assert k == 2 * kh, (x.shape, packed.shape)
-    bm = min(block_m, m)
-    bn = min(block_n, n)
-    if m % bm or n % bn:
-        raise ValueError(f"shape ({m},{n}) not divisible by ({bm},{bn})")
-    grid = (m // bm, n // bn)
+    assert k == group * scales.shape[0], (k, group, scales.shape)
+    bm = block_for(m, tile=block_m)
+    bn = block_for(n, tile=block_n, floor=128)
+    # whole groups per K tile: lcm(group, 2) ≤ bk ≤ k, group-aligned
+    unit = group if group % 2 == 0 else 2 * group
+    bk = max(unit, min(block_k, k) // unit * unit)
+    grid = (pl.cdiv(m, bm), pl.cdiv(n, bn), pl.cdiv(k, bk))
     return pl.pallas_call(
-        functools.partial(_q4mm_kernel, group),
+        functools.partial(_q4mm_kernel, group, grid[2], k, bk),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((kh, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((k // group, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((bm, bk), lambda i, j, ki: (i, ki)),
+            pl.BlockSpec((bk // 2, bn), lambda i, j, ki: (ki, j)),
+            pl.BlockSpec((bk // group, bn), lambda i, j, ki: (ki, j)),
         ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, ki: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("parallel", "parallel"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(x, packed, scales)
 
 
 def quantized_matmul_int4(x, packed, scales, *, group=INT4_GROUP,
-                          interpret=None):
-    """Dispatch like :func:`quantized_matmul`: pallas on TPU (or
-    interpret for tests), XLA dequant-matmul elsewhere."""
-    from sparkdl_tpu.ops._dispatch import block_for, pad_to, use_pallas
-
-    if interpret is None:
-        if not use_pallas():
-            w = _dequant_int4(packed, scales, group)
-            return (x.astype(jnp.float32) @ w).astype(x.dtype)
-        interpret = False
-    m, n = x.shape[0], packed.shape[1]
-    bm, bn = block_for(m), block_for(n, floor=128)
-    x, pad_m = pad_to(x, bm, 0)
-    packed, pad_n = pad_to(packed, bn, 1)
-    scales, _ = pad_to(scales, bn, 1)
-    out = quantized_matmul_int4_pallas(
-        x, packed, scales, group=group, block_m=bm, block_n=bn,
-        interpret=interpret,
-    )
-    return out[:m, :n] if (pad_m or pad_n) else out
+                          interpret=None, mode=""):
+    """Dispatch like :func:`quantized_matmul`, plus int4-specific
+    support checks: a ``group`` that does not cover K with the given
+    scale rows degrades loudly to the XLA lowering under the group the
+    shapes imply (never a wrong answer), and raises when no consistent
+    group exists."""
+    k = x.shape[1]
+    s_rows = scales.shape[0]
+    if k != 2 * packed.shape[0]:
+        raise ValueError(
+            f"packed int4 weight has {packed.shape[0]} rows; K={k} "
+            "activations need K//2")
+    if interpret is not None:
+        use_kernel, interp = True, bool(interpret)
+    else:
+        use_kernel, interp = _kernel_plan(mode)
+    if group <= 0 or group * s_rows != k:
+        if s_rows == 0 or k % s_rows:
+            raise ValueError(
+                f"int4 scales with {s_rows} rows cannot cover K={k} "
+                f"under any group (requested group={group})")
+        inferred = k // s_rows
+        _fallback_warn(
+            f"group={group} does not cover K={k} with {s_rows} scale "
+            f"rows; using inferred group={inferred}")
+        group, use_kernel = inferred, False
+    if use_kernel and packed.dtype != jnp.int8:
+        _fallback_warn(f"packed dtype {packed.dtype} is not int8")
+        use_kernel = False
+    if not use_kernel:
+        w = _dequant_int4(packed, scales, group)
+        return (x.astype(jnp.float32) @ w).astype(x.dtype)
+    return quantized_matmul_int4_pallas(
+        x, packed, scales, group=group, interpret=interp)

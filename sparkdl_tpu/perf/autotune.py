@@ -131,6 +131,15 @@ class SubprocessTrialRunner:
         """Breakdown-fractions report used for pruning, or None."""
         return None
 
+    def pick_primary(self, metrics):
+        """Primary metric for a runner that declares none: sole metric
+        of the ledger line, or a subclass's shape-aware choice."""
+        if len(metrics) != 1:
+            raise TrialError(
+                f"{self.bench} ledger line has {len(metrics)} "
+                "metrics and the runner declares no primary")
+        return next(iter(metrics))
+
     def _bounded_run(self, args, env):
         """subprocess with a REAL timeout (the bench.py lesson): a
         child wedged in an accelerator runtime can survive the
@@ -191,11 +200,7 @@ class SubprocessTrialRunner:
         self.device_kind = entry.get("device_kind")
         metrics = entry.get("metrics") or {}
         if self.primary_metric is None:
-            if len(metrics) != 1:
-                raise TrialError(
-                    f"{self.bench} ledger line has {len(metrics)} "
-                    "metrics and the runner declares no primary")
-            self.primary_metric = next(iter(metrics))
+            self.primary_metric = self.pick_primary(metrics)
         if self.primary_metric not in metrics:
             raise TrialError(
                 f"{self.bench} ledger line is missing the primary "
@@ -249,8 +254,46 @@ class ServeRunner(SubprocessTrialRunner):
                 os.path.join(ROOT, "benchmarks", "serve_bench.py")]
 
 
+class AttentionRunner(SubprocessTrialRunner):
+    """Flash-attention kernel-leg bench — the tile-knob search target
+    (``SPARKDL_TPU_FLASH_BLOCK_Q``/``_KV``). Trials read the A/B
+    section's KERNEL ledger line: on TPU that is the real pallas
+    kernel, on cpu the interpret-mode emulation — tile choices change
+    the measured program either way, which is what makes the search
+    meaningful off-hardware (the fallback leg would be tile-blind on
+    cpu). The harness emits one ``attn_ms_s{seq}`` metric per
+    measured sequence; the shortest is the primary (the serving-side
+    regime), and verification still holds the whole record to
+    no-worse."""
+
+    bench = "attention"
+    ledger_bench = "attention_bench:kernel"
+
+    def command(self):
+        return [sys.executable,
+                os.path.join(ROOT, "benchmarks", "attention_bench.py")]
+
+    def attribution(self):
+        # static, like CpuProxyRunner: one jitted kernel scan — no
+        # input pipeline, no collectives
+        return {
+            "source": "static:attention_bench jitted kernel scan",
+            "fractions": {"compute": 1.0, "data_wait": 0.0,
+                          "collective": 0.0, "host_callback": 0.0},
+        }
+
+    def pick_primary(self, metrics):
+        seqs = sorted(
+            (m for m in metrics if m.startswith("attn_ms_s")),
+            key=lambda m: int(m.rsplit("_s", 1)[1]))
+        if not seqs:
+            raise TrialError(
+                "attention kernel ledger line has no attn_ms_s* metric")
+        return seqs[0]
+
+
 RUNNERS = {"cpu-proxy": CpuProxyRunner, "gbdt": GbdtRunner,
-           "serve": ServeRunner}
+           "serve": ServeRunner, "attention": AttentionRunner}
 
 
 # -- space derivation + pruning ---------------------------------------------

@@ -67,7 +67,8 @@ class Knob:
     help: str = ""
     tunable: bool = False
     trial_values: tuple = ()
-    benches: tuple = ()  # trial harnesses that honor it: cpu-proxy|serve|gbdt
+    benches: tuple = ()  # trial harnesses that honor it:
+                         # cpu-proxy|serve|gbdt|attention
     component: str = None  # attribution component gating its relevance
 
 
@@ -430,7 +431,30 @@ def _build():
 
         # -- kernels / interop --------------------------------------
         k("SPARKDL_TPU_FLASH_BLOCK", "int", None, "kernels",
-          "flash-attention block size override"),
+          "flash-attention block size override (legacy square tile; "
+          "the per-dimension _Q/_KV knobs win when set)"),
+        k("SPARKDL_TPU_FLASH_BLOCK_Q", "int", None, "kernels",
+          "flash-attention query tile (rows of scores each grid "
+          "program owns); read once at import of ops.attention",
+          tunable=True, trial_values=(128, 256),
+          benches=("attention",), component="compute"),
+        k("SPARKDL_TPU_FLASH_BLOCK_KV", "int", None, "kernels",
+          "flash-attention key/value tile (K/V stream granularity of "
+          "the inner loop); read once at import of ops.attention",
+          tunable=True, trial_values=(128, 256),
+          benches=("attention",), component="compute"),
+        k("SPARKDL_TPU_PAGED_PAGES_PER_BLOCK", "int", "1", "kernels",
+          "KV page tiles DMA'd per paged-decode grid step (wider "
+          "steps amortize grid overhead at long contexts, cost VMEM)",
+          tunable=True, trial_values=(1, 2, 4),
+          benches=("serve",)),
+        k("SPARKDL_TPU_KERNEL_QUANT_MATMUL", "enum", "auto", "kernels",
+          "fused int8/int4 quant-matmul dispatch: auto = pallas "
+          "kernel on TPU / XLA dequant elsewhere, off = XLA dequant "
+          "everywhere, force_interpret = emulated kernel (CPU "
+          "equivalence oracle); unsupported shapes degrade to XLA "
+          "loudly", tunable=True, trial_values=("auto", "off"),
+          benches=("serve",)),
         k("SPARKDL_TPU_TORCH_DLPACK", "bool", None, "interop",
           "torch interop: force/disable dlpack zero-copy"),
 

@@ -223,6 +223,26 @@ class TestQuantDenseEquivalence:
                / (np.abs(dense).mean() + 1e-9))
         assert rel < 0.15, rel
 
+    def test_quant_kernel_threads_to_modules(self, setup):
+        """cfg.quant_kernel reaches every QuantDense(4) the model
+        builds — the knob is program config, so a silent drop here
+        would leave the engine on the fallback forever."""
+        cfg, model, tokens, params = setup
+        q_tree = quantize_llama_params(params)
+        cfg_q = dataclasses.replace(cfg, quant="int8",
+                                    quant_kernel="off")
+        out_off = Llama(cfg_q).apply({"params": q_tree}, tokens)
+        cfg_k = dataclasses.replace(cfg_q,
+                                    quant_kernel="force_interpret")
+        out_kern = Llama(cfg_k).apply({"params": q_tree}, tokens)
+        np.testing.assert_allclose(np.asarray(out_off),
+                                   np.asarray(out_kern),
+                                   atol=1e-4, rtol=1e-5)
+
+    def test_config_rejects_unknown_quant_kernel(self):
+        with pytest.raises(ValueError, match="quant_kernel"):
+            LlamaConfig.tiny(quant="int8", quant_kernel="fastest")
+
     def test_quantdense4_nondefault_group_via_config(self, setup):
         """A tree quantized at a non-default group serves through
         ``LlamaConfig.quant_group`` (flax pins param shapes, so the
@@ -238,3 +258,90 @@ class TestQuantDenseEquivalence:
         np.testing.assert_allclose(np.asarray(out_q),
                                    np.asarray(out_d),
                                    atol=2e-3, rtol=2e-3)
+
+
+@pytest.fixture(scope="module")
+def routing_setup():
+    # 1 layer, not tiny()'s 2: the routing contract is per-GEMM, and
+    # interpret-mode pallas pays python for every dispatched call
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, n_layers=1)
+    model = Llama(cfg)
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    params = jax.tree.map(
+        lambda p: p * 1.7 if p.ndim == 2 else p, params
+    )
+    return cfg, model, params
+
+
+class TestEngineKernelRouting:
+    """ISSUE 19 satellite: ``ContinuousBatchingEngine(quant_kernel=...)``
+    routes the engine's dequant GEMMs through the pallas quant-matmul
+    tier. Token-exactness is the contract: a replica that dispatches
+    the kernel must answer EXACTLY like one pinned to the XLA dequant
+    lowering — single device and TP mesh alike — or a heterogeneous
+    fleet diverges request-by-request."""
+
+    def _tokens(self, engine, prompts, budgets):
+        rids = [engine.submit(p, b) for p, b in zip(prompts, budgets)]
+        res = engine.run()
+        return [np.asarray(res[r]) for r in rids]
+
+    def _prompts(self, cfg, seed=31):
+        # short budgets: interpret-mode pallas pays python per call, and
+        # exactness at 3 tokens is exactness at 300
+        rng = np.random.default_rng(seed)
+        return ([rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                 for n in (3, 5)], [2, 3])
+
+    @pytest.mark.parametrize("quant", ["int8", "int4"])
+    def test_engine_token_exact_kernel_vs_xla(self, routing_setup,
+                                              quant):
+        from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+
+        cfg, model, params = routing_setup
+        prompts, budgets = self._prompts(cfg)
+        legs = {}
+        for mode in ("off", "force_interpret"):
+            eng = ContinuousBatchingEngine(
+                model, params, n_slots=2, chunk=4, quant=quant,
+                quant_kernel=mode)
+            legs[mode] = self._tokens(eng, prompts, budgets)
+        for a, b in zip(legs["off"], legs["force_interpret"]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_engine_tp_mesh_token_exact(self, routing_setup):
+        """model=2 TP: the quantized GEMMs are Megatron-sharded, so
+        the kernel sees the SHARDED (K, N/2) weights — tokens must
+        still match the single-device XLA-pinned engine exactly."""
+        from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+        from sparkdl_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        cfg, model, params = routing_setup
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        mesh = make_mesh(MeshSpec(data=4, model=2))
+        prompts, budgets = self._prompts(cfg, seed=32)
+
+        base = self._tokens(
+            ContinuousBatchingEngine(
+                model, params, n_slots=2, chunk=4, quant="int8",
+                quant_kernel="off"),
+            prompts, budgets)
+        tp_kernel = self._tokens(
+            ContinuousBatchingEngine(
+                model, params, n_slots=2, chunk=4, quant="int8",
+                quant_kernel="force_interpret", mesh=mesh),
+            prompts, budgets)
+        for b, t in zip(base, tp_kernel):
+            np.testing.assert_array_equal(b, t)
+
+    def test_quant_kernel_without_quant_refused(self, setup):
+        from sparkdl_tpu.models.serving import ContinuousBatchingEngine
+
+        cfg, model, tokens, params = setup
+        with pytest.raises(ValueError, match="quant_kernel"):
+            ContinuousBatchingEngine(
+                model, params, n_slots=2, quant_kernel="auto")

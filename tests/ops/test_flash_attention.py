@@ -71,6 +71,45 @@ def test_dispatch_falls_back_on_cpu():
     assert out.shape == q.shape
 
 
+@pytest.mark.parametrize("bq,bkv", [(64, 128), (128, 64), (256, 128)])
+def test_tunable_tiles_match_reference(bq, bkv):
+    """ISSUE 19: block_q/block_kv are autotuner search axes — every
+    tile pair (including asymmetric ones, which force lcm padding of
+    a non-multiple sequence) must be an equivalence-preserving
+    reparameterization of the SAME attention."""
+    rng = np.random.RandomState(7)
+    b, s, h, d = 1, 200, 2, 16
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=bq,
+                          block_kv=bkv, interpret=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_tunable_tiles_gradients_match():
+    """The tile pair rides the custom_vjp nondiff args — the backward
+    kernel must honor the same tiles the forward ran with."""
+    rng = np.random.RandomState(8)
+    b, s, h, d = 1, 192, 2, 16
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    g1 = jax.grad(
+        lambda q_: flash_attention(q_, k, v, causal=True, block_q=64,
+                                   block_kv=128, interpret=True).sum()
+    )(q)
+    g2 = jax.grad(
+        lambda q_: attention_reference(q_, k, v, causal=True).sum()
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g1), np.asarray(g2), atol=5e-5, rtol=5e-5
+    )
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_fused_backward_all_grads_match(causal):
     """The fused pallas backward must match dense-attention autodiff for

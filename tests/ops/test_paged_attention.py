@@ -84,3 +84,22 @@ def test_junk_pages_cannot_leak():
         interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("ppb", [1, 2, 3, 4, 16])
+def test_pages_per_block_is_equivalence_preserving(ppb):
+    """ISSUE 19: pages_per_block is an autotuner search axis — every
+    widening (including one that does not divide the table width, and
+    one past it, which must clamp) attends the same pages and matches
+    the gather oracle. Ragged lengths keep the per-page @pl.when
+    bounds honest inside a widened block."""
+    rng = np.random.default_rng(2)
+    b, hkv, rep, d, page, ppr = 3, 2, 2, 16, 8, 4
+    q, k_pool, v_pool, tables = _setup(rng, b, hkv, rep, d, page, ppr,
+                                       n_pages=b * ppr + 1)
+    lens = jnp.asarray([1, page * 2 + 3, page * ppr], jnp.int32)
+    ref = _oracle(q, k_pool, v_pool, tables, lens)
+    out = paged_attention_decode(q, k_pool, v_pool, tables, lens,
+                                 pages_per_block=ppb, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -245,3 +245,190 @@ class TestThreeWayEquivalence:
             rel = (np.abs(q - dense).mean()
                    / (np.abs(dense).mean() + 1e-9))
             assert rel < 0.15, rel
+
+
+class TestEdgeShapes:
+    """ISSUE 19 satellite: ragged tiles. Non-divisible M/N/K are served
+    by masked edge tiles inside the kernel, never host padding — so
+    every odd serving shape must match the XLA dequant oracle at the
+    same tolerance as the aligned shapes."""
+
+    @pytest.mark.parametrize("m,k,n", [
+        (137, 203, 300),   # all three ragged vs the 128 tiles
+        (5, 96, 130),      # tiny M, sub-tile K, barely-over-tile N
+        (1, 64, 129),      # decode row: single token
+    ])
+    def test_int8_nondivisible_mkn(self, m, k, n):
+        rng = np.random.RandomState(11)
+        x = jnp.asarray(rng.randn(m, k), jnp.float32)
+        w = rng.randn(k, n).astype(np.float32)
+        w_q, s = quantize_int8(w)
+        out = quantized_matmul(
+            x, jnp.asarray(w_q), jnp.asarray(s), interpret=True)
+        assert out.shape == (m, n)
+        ref = np.asarray(x) @ (w_q.astype(np.float32) * s[None, :])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3,
+                                   rtol=1e-4)
+
+    def test_int8_ragged_final_k_tile_multi_step(self):
+        """K spanning several K tiles with a ragged last one — the
+        masked-iota path in the kernel body, which a single-tile K
+        (k <= block_k) never exercises."""
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            quantized_matmul_pallas,
+        )
+
+        rng = np.random.RandomState(12)
+        m, k, n = 32, 203, 128          # block_k=64 → tiles 64,64,64,11
+        x = jnp.asarray(rng.randn(m, k), jnp.float32)
+        w = rng.randn(k, n).astype(np.float32)
+        w_q, s = quantize_int8(w)
+        out = quantized_matmul_pallas(
+            x, jnp.asarray(w_q), jnp.asarray(s), block_k=64,
+            interpret=True)
+        ref = np.asarray(x) @ (w_q.astype(np.float32) * s[None, :])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3,
+                                   rtol=1e-4)
+
+    @pytest.mark.parametrize("m,n,group", [
+        (137, 130, 64),    # ragged M/N, multi-group K
+        (96, 72, 192),     # odd group = whole K (one scale row)
+    ])
+    def test_int4_nondivisible_mn(self, m, n, group):
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            _dequant_int4,
+            quantize_int4,
+            quantized_matmul_int4,
+        )
+
+        k = 192
+        rng = np.random.RandomState(13)
+        x = jnp.asarray(rng.randn(m, k), jnp.float32)
+        w = rng.randn(k, n).astype(np.float32)
+        packed, s = quantize_int4(w, group=group)
+        out = quantized_matmul_int4(
+            x, jnp.asarray(packed), jnp.asarray(s), group=group,
+            interpret=True)
+        assert out.shape == (m, n)
+        deq = _dequant_int4(jnp.asarray(packed), jnp.asarray(s), group)
+        ref = np.asarray(x.astype(jnp.float32) @ deq)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3,
+                                   rtol=1e-4)
+
+    def test_int4_ragged_k_tile_multi_step(self):
+        """Multi-K-tile int4 with a group-aligned block_k smaller than
+        K: the in-kernel group dequant must see whole groups per step
+        and the accumulator must carry across steps."""
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            _dequant_int4,
+            quantize_int4,
+            quantized_matmul_int4_pallas,
+        )
+
+        k, group = 256, 64
+        rng = np.random.RandomState(14)
+        x = jnp.asarray(rng.randn(33, k), jnp.float32)
+        w = rng.randn(k, 130).astype(np.float32)
+        packed, s = quantize_int4(w, group=group)
+        out = quantized_matmul_int4_pallas(
+            x, jnp.asarray(packed), jnp.asarray(s), group=group,
+            block_k=group, interpret=True)   # 4 sequential K tiles
+        deq = _dequant_int4(jnp.asarray(packed), jnp.asarray(s), group)
+        ref = np.asarray(x.astype(jnp.float32) @ deq)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3,
+                                   rtol=1e-4)
+
+
+class TestDispatchModes:
+    """ISSUE 19 satellite: the SPARKDL_TPU_KERNEL_QUANT_MATMUL plan.
+    Unsupported inputs degrade to the XLA lowering LOUDLY
+    (RuntimeWarning) and still return the right answer; a shape no
+    group can explain raises; unknown modes raise."""
+
+    def _int8_case(self, seed=21, m=16, k=64, n=96):
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(m, k), jnp.float32)
+        w_q, s = quantize_int8(rng.randn(k, n).astype(np.float32))
+        ref = np.asarray(x) @ (w_q.astype(np.float32) * s[None, :])
+        return x, jnp.asarray(w_q), jnp.asarray(s), ref
+
+    def test_mode_off_pins_xla_lowering(self):
+        x, w_q, s, ref = self._int8_case()
+        out = np.asarray(quantized_matmul(x, w_q, s, mode="off"))
+        np.testing.assert_array_equal(out, ref.astype(np.float32))
+
+    def test_mode_force_interpret_runs_kernel(self):
+        x, w_q, s, ref = self._int8_case()
+        out = np.asarray(quantized_matmul(
+            x, w_q, s, mode="force_interpret"))
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-5)
+
+    def test_unknown_mode_raises(self):
+        x, w_q, s, _ = self._int8_case()
+        with pytest.raises(ValueError, match="quant-matmul kernel mode"):
+            quantized_matmul(x, w_q, s, mode="fastest")
+
+    def test_int8_bad_dtype_falls_back_loudly(self):
+        x, w_q, s, ref = self._int8_case()
+        with pytest.warns(RuntimeWarning, match="degrading to the XLA"):
+            out = quantized_matmul(
+                x, w_q.astype(jnp.int32), s, mode="force_interpret")
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4,
+                                   rtol=1e-5)
+
+    def test_int8_bad_scales_raise(self):
+        """A mis-shaped scale vector is a caller bug with no correct
+        lowering — the XLA path would BROADCAST it into a wrong-shaped
+        product, so it raises under every mode (including "off")."""
+        x, w_q, s, _ = self._int8_case()
+        for mode in ("off", "force_interpret"):
+            with pytest.raises(ValueError, match="scales shape"):
+                quantized_matmul(x, w_q, s[None, :], mode=mode)
+
+    def test_int4_wrong_group_falls_back_loudly_not_wrongly(self):
+        """group=96 cannot cover K=128 with 2 scale rows — the shapes
+        imply group 64, so the call must warn, use the XLA lowering
+        under the INFERRED group, and match the group=64 oracle."""
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            _dequant_int4,
+            quantize_int4,
+            quantized_matmul_int4,
+        )
+
+        rng = np.random.RandomState(22)
+        x = jnp.asarray(rng.randn(16, 128), jnp.float32)
+        packed, s = quantize_int4(
+            rng.randn(128, 96).astype(np.float32), group=64)
+        with pytest.warns(RuntimeWarning, match="inferred group=64"):
+            out = quantized_matmul_int4(
+                x, jnp.asarray(packed), jnp.asarray(s), group=96,
+                mode="force_interpret")
+        deq = _dequant_int4(jnp.asarray(packed), jnp.asarray(s), 64)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x @ deq), atol=1e-4, rtol=1e-5)
+
+    def test_int4_impossible_group_raises(self):
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            quantized_matmul_int4,
+        )
+
+        rng = np.random.RandomState(23)
+        x = jnp.asarray(rng.randn(8, 128), jnp.float32)
+        packed = jnp.asarray(
+            rng.randint(-8, 8, (64, 32)).astype(np.int8))
+        scales = jnp.ones((3, 32), jnp.float32)   # 128 % 3 != 0
+        with pytest.raises(ValueError, match="cannot cover K=128"):
+            quantized_matmul_int4(x, packed, scales, group=96)
+
+    def test_int4_packed_rows_mismatch_raises(self):
+        from sparkdl_tpu.ops.pallas.quantized_matmul import (
+            quantized_matmul_int4,
+        )
+
+        rng = np.random.RandomState(24)
+        x = jnp.asarray(rng.randn(8, 128), jnp.float32)
+        packed = jnp.asarray(
+            rng.randint(-8, 8, (60, 32)).astype(np.int8))   # needs 64
+        scales = jnp.ones((2, 32), jnp.float32)
+        with pytest.raises(ValueError, match="K//2"):
+            quantized_matmul_int4(x, packed, scales)

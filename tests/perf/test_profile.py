@@ -220,6 +220,46 @@ def test_profile_survives_supervised_relaunch(tmp_path, monkeypatch):
     assert seen[1]["SPARKDL_TPU_RESTART_ATTEMPT"] == "1"
 
 
+def test_tile_profile_survives_supervised_relaunch(tmp_path,
+                                                   monkeypatch):
+    """ISSUE 19 acceptance: a kernel TILE profile — the autotuned
+    flash block committed under profiles/<kind>/attention.json — rides
+    the same pre-flight path and survives a supervised gang relaunch,
+    so retuned tiles outlive preemption exactly like training knobs."""
+    from sparkdl_tpu.horovod.supervisor import (
+        GangFailure,
+        RetryPolicy,
+        supervise,
+    )
+
+    tile = "SPARKDL_TPU_FLASH_BLOCK_Q"
+    doc = prof.make_profile(
+        {tile: "256"}, device_kind="cpu", bench="attention",
+        status=prof.STATUS_VERIFIED)
+    prof.save_profile(
+        doc, prof.profile_path("cpu", "attention", root=str(tmp_path)))
+    monkeypatch.setenv(prof.PROFILE_ENV, str(tmp_path))
+    monkeypatch.setenv("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    monkeypatch.delenv(tile, raising=False)
+
+    seen = []
+
+    def launch(extra_env):
+        env = _worker_env_with_profile(extra_env)
+        seen.append(env)
+        if len(seen) == 1:
+            raise GangFailure("transient boom",
+                              kind="rendezvous_timeout")
+        return "ok"
+
+    policy = RetryPolicy(max_retries=2, backoff_base=0.0,
+                         backoff_max=0.0, jitter=0.0)
+    assert supervise(launch, policy, _sleep=lambda s: None) == "ok"
+    assert len(seen) == 2
+    for env in seen:
+        assert env[tile] == "256"
+
+
 def test_operator_pin_survives_relaunch_over_profile(tmp_path,
                                                      monkeypatch):
     doc, path = _verified(tmp_path)

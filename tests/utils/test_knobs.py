@@ -85,7 +85,7 @@ def test_registry_shape():
             assert kb.trial_values, (
                 f"{kb.name}: tunable knobs must declare trial_values")
         for bench in kb.benches:
-            assert bench in ("cpu-proxy", "serve", "gbdt")
+            assert bench in ("cpu-proxy", "serve", "gbdt", "attention")
 
 
 def test_prefix_family_membership():
@@ -132,3 +132,7 @@ def test_tunable_bench_filter():
     assert "SPARKDL_TPU_BENCH_NO_DONATE" not in cpu
     gbdt = {kb.name for kb in knobs.tunable_knobs("gbdt")}
     assert "SPARKDL_TPU_GBDT_MAX_BINS" in gbdt
+    attn = {kb.name for kb in knobs.tunable_knobs("attention")}
+    assert {"SPARKDL_TPU_FLASH_BLOCK_Q",
+            "SPARKDL_TPU_FLASH_BLOCK_KV"} <= attn
+    assert "SPARKDL_TPU_LOSS_CHUNK" not in attn
