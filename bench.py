@@ -480,6 +480,22 @@ def run():
     params, opt_state, last = run_n(params, opt_state, batch_data)
     _ = np.asarray(last)
 
+    # --capture: wrap the measured region (timed run + rate reps,
+    # warm-up excluded) in the same bounded-profile shim the live
+    # forensics capture uses (jax_compat.profiler_trace — None-never-
+    # raise, so a runtime without the profiler still measures); the
+    # artifact path rides the JSON line as ``capture_dir``.
+    capture_trace = capture_dir = None
+    if os.environ.get("SPARKDL_TPU_BENCH_CAPTURE") \
+            or "--capture" in sys.argv:
+        from sparkdl_tpu.utils import jax_compat
+
+        target = os.environ.get("SPARKDL_TPU_BENCH_CAPTURE_DIR") \
+            or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "results", "xprof-bench")
+        capture_trace = jax_compat.profiler_trace(target)
+        capture_dir = capture_trace.__enter__()
+
     t0 = time.perf_counter()
     params, opt_state, last = run_n(params, opt_state, batch_data)
     last_loss = float(np.asarray(last))  # host readback = true sync
@@ -503,6 +519,8 @@ def run():
         params, opt_state, last = run_n(params, opt_state, batch_data)
         _ = float(np.asarray(last))
         rates.append(n_steps / (time.perf_counter() - t0))
+    if capture_trace is not None:
+        capture_trace.__exit__(None, None, None)
     # p99 is the SLOW tail (the rate at the 99th percentile of step
     # latency — reciprocal is monotonic, so that's the 1st percentile
     # of the rate samples): p99 <= p50 by construction.
@@ -591,6 +609,8 @@ def run():
         "host": perf.host_fingerprint(),
         "rate_samples": [round(r * batch * seq, 1) for r in rates],
         **({"promoted": promoted} if promoted else {}),
+        **({"capture_dir": capture_dir}
+           if capture_trace is not None else {}),
     }
     if not cpu_proxy:
         # MFU is computed against the CHIP's peak FLOPs — meaningless
@@ -650,6 +670,10 @@ def _bounded_run(args, env, timeout):
 def orchestrate():
     env = dict(os.environ)
     here = os.path.abspath(__file__)
+    if "--capture" in sys.argv:
+        # the measured run lands in a child subprocess whose argv we
+        # own — forward the flag through the env it does inherit
+        env["SPARKDL_TPU_BENCH_CAPTURE"] = "1"
 
     def attempt_probe():
         rc, out, err = _bounded_run(

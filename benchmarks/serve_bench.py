@@ -529,6 +529,11 @@ def main(argv=None):
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--no-ledger", action="store_true",
                     help="do not append to the history.jsonl ledger")
+    ap.add_argument("--capture", action="store_true",
+                    help="profile the measured load (the same bounded "
+                         "jax_compat.profiler_trace shim the live "
+                         "forensics capture uses); the artifact dir "
+                         "rides the JSON record as capture_dir")
     args = ap.parse_args(argv)
     if args.quant not in ("", "int8", "int4"):
         # argparse validates `choices` only for explicitly passed
@@ -586,9 +591,26 @@ def main(argv=None):
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
 
-    result, problems = run_load(args, model, params, cfg.vocab_size,
-                                quant="" if args.ab_quant
-                                else args.quant)
+    # --capture: profile the measured load (warmup + drive + scrape,
+    # the region a TTFT regression would hide in) — None-never-raise,
+    # so a runtime without the profiler still benches.
+    capture_trace = capture_dir = None
+    if args.capture:
+        from sparkdl_tpu.utils import jax_compat
+
+        target = os.environ.get("SPARKDL_TPU_BENCH_CAPTURE_DIR") \
+            or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "results", "xprof-serve-bench")
+        capture_trace = jax_compat.profiler_trace(target)
+        capture_dir = capture_trace.__enter__()
+    try:
+        result, problems = run_load(args, model, params,
+                                    cfg.vocab_size,
+                                    quant="" if args.ab_quant
+                                    else args.quant)
+    finally:
+        if capture_trace is not None:
+            capture_trace.__exit__(None, None, None)
     metrics = _ledger_metrics(result)
     ab = None
     if args.ab_quant:
@@ -648,6 +670,7 @@ def main(argv=None):
         "hbm_high_water_bytes": hbm_high_water,
         "host_rss_high_water_bytes": host_rss_high_water,
         "history": history,
+        **({"capture_dir": capture_dir} if args.capture else {}),
     }
     record.update(
         {k: v for k, v in result.items() if not k.startswith("_")})

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""CI perf-forensics smoke (ISSUE 20): boot a 2-rank gang with the
+alert engine and alert-triggered profiling armed, starve rank 1's
+input pipeline mid-run, and FAIL the build unless the whole forensic
+loop closes against a REAL running gang:
+
+1. the injected slowdown trips ``step_time_regression`` on rank 1;
+2. the firing triggers a capture on rank 1 ONLY — a
+   ``profile_report-rank-1-*.json`` with uncapped per-step
+   attribution rows lands in the run dir, no rank-0 alert capture;
+3. ``regression_report.json`` names the injected component
+   (``data_wait``) and the grown span (``input.next``), and links the
+   capture artifact;
+4. the manual leg works mid-run: ``POST /capturez?rank=0`` on the
+   statusz endpoint answers ok and produces a rank-0 manual capture;
+5. ``observe.doctor`` renders the "perf forensics" section from the
+   artifacts alone, and ``observe.top`` renders the live ``captures``
+   block when the scraper caught one.
+
+Usage: ``SPARKDL_TPU_TELEMETRY_DIR=<dir> python ci/forensics_smoke.py``
+(defaults the dir to ``./forensics-artifacts``). Runs outside the
+time-boxed tier-1 pytest gate — its own workflow step; the run dir,
+the capturez response, the top frame and the doctor report are left
+in the artifact dir for upload.
+"""
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+# Runnable as `python ci/forensics_smoke.py` from a checkout: the
+# script dir (ci/) is sys.path[0], the package root is one up.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEADLINE_S = 300
+
+
+def fail(msg):
+    print(f"FORENSICS SMOKE FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _victim_rank_main(n_fast, n_slow, fast_s, slow_s):
+    """Rank 1 starts stalling on its input pipeline mid-run — a
+    cat="data" span the differential attribution can NAME; rank 0
+    keeps pace."""
+    import time as _time
+
+    from sparkdl_tpu import observe as _observe
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.parallel.train import instrument_step
+
+    hvd.init()
+    victim = hvd.rank() == 1
+
+    def step(i):
+        if victim and i >= n_fast:
+            with _observe.span("input.next", cat="data"):
+                _time.sleep(slow_s)
+        else:
+            _time.sleep(fast_s)
+        return i
+
+    stepped = instrument_step(step)
+    for i in range(n_fast + n_slow):
+        stepped(i)
+    return hvd.rank()
+
+
+class Scraper(threading.Thread):
+    """Mid-run driver: waits for both ranks on /statusz, fires the
+    manual ``POST /capturez?rank=0`` leg, then keeps polling for a
+    /statusz doc whose ``captures`` block shows a completed capture."""
+
+    def __init__(self, base):
+        super().__init__(name="forensics-smoke-scraper", daemon=True)
+        self.base = base
+        self.capturez_response = None
+        self.captures_doc = None
+
+    def run(self):
+        deadline = time.monotonic() + DEADLINE_S
+        while time.monotonic() < deadline:
+            try:
+                doc = json.loads(_get(f"{self.base}/statusz"))
+            except (OSError, ValueError):
+                time.sleep(0.15)
+                continue
+            ranks = doc.get("ranks") or {}
+            both_up = all(
+                isinstance(ranks.get(str(r), {}).get("step"), int)
+                for r in (0, 1))
+            if both_up and self.capturez_response is None:
+                try:
+                    req = urllib.request.Request(
+                        f"{self.base}/capturez?rank=0", data=b"",
+                        method="POST")
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        self.capturez_response = json.loads(
+                            r.read().decode())
+                except (OSError, ValueError):
+                    pass
+            captures = doc.get("captures") or {}
+            if captures.get("completed"):
+                self.captures_doc = doc
+                return
+            time.sleep(0.15)
+
+
+def main():
+    out_dir = os.environ.setdefault(
+        "SPARKDL_TPU_TELEMETRY_DIR",
+        os.path.join(os.getcwd(), "forensics-artifacts"),
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    os.environ.setdefault("SPARKDL_TPU_WORKER_PLATFORM", "cpu")
+    port = _free_port()
+    os.environ.update({
+        "SPARKDL_TPU_TELEMETRY_FLUSH_S": "0.1",
+        "SPARKDL_TPU_HEARTBEAT_S": "0.2",
+        "SPARKDL_TPU_STATUSZ_PORT": str(port),
+        "SPARKDL_TPU_ALERTS": "1",
+        "SPARKDL_TPU_ALERT_CHECK_S": "0.1",
+        "SPARKDL_TPU_ALERT_MIN_STEPS": "3",
+        "SPARKDL_TPU_ALERT_WINDOW_S": "3",
+        "SPARKDL_TPU_ALERT_STEP_FACTOR": "2.0",
+        "SPARKDL_TPU_PROFILE_ON_ALERT": "1",
+        "SPARKDL_TPU_PROFILE_STEPS": "3",
+        "SPARKDL_TPU_PROFILE_COOLDOWN_S": "600",
+    })
+
+    from sparkdl import HorovodRunner
+
+    scraper = Scraper(f"http://127.0.0.1:{port}")
+    scraper.start()
+    t0 = time.monotonic()
+    HorovodRunner(np=-2).run(
+        _victim_rank_main, n_fast=12, n_slow=20,
+        fast_s=0.05, slow_s=0.3)
+    elapsed = time.monotonic() - t0
+    scraper.join(timeout=10)
+    print(f"gang finished in {elapsed:.1f}s")
+    if elapsed > DEADLINE_S:
+        fail(f"gang took {elapsed:.0f}s (deadline {DEADLINE_S}s)")
+
+    run_dirs = glob.glob(os.path.join(out_dir, "run-*"))
+    if len(run_dirs) != 1:
+        fail(f"expected one run dir under {out_dir}, found {run_dirs}")
+    run_dir = run_dirs[0]
+
+    # 1. the slowdown tripped step_time_regression on the victim
+    alerts = json.load(open(os.path.join(run_dir, "alerts.json")))
+    fired = [a for a in (alerts.get("alerts") or [])
+             if a.get("rule") == "step_time_regression"]
+    if not fired:
+        fail("step_time_regression never fired")
+    if any(a.get("rank") != 1 for a in fired):
+        fail(f"regression fired off the victim rank: {fired}")
+
+    # 2. the alert capture landed on rank 1 ONLY
+    reports = {}
+    for p in glob.glob(os.path.join(run_dir, "profile_report-*.json")):
+        reports[os.path.basename(p)] = json.load(open(p))
+    alert_reports = {n: r for n, r in reports.items()
+                     if r.get("rule") == "step_time_regression"}
+    if not alert_reports:
+        fail(f"no alert-triggered capture artifact in {run_dir} "
+             f"(found: {sorted(reports)})")
+    for name, rep in alert_reports.items():
+        if rep.get("rank") != 1 or "rank-1-" not in name:
+            fail(f"alert capture landed on the wrong rank: {name}")
+        if rep.get("steps_captured", 0) < 1:
+            fail(f"alert capture {name} recorded no steps")
+        if rep.get("attribution", {}).get("steps", 0) < 1:
+            fail(f"alert capture {name} has no attribution rows")
+
+    # 3. regression_report.json names the injected component
+    reg = json.load(
+        open(os.path.join(run_dir, "regression_report.json")))
+    entries = [e for e in (reg.get("reports") or [])
+               if e.get("rule") == "step_time_regression"]
+    if not entries:
+        fail("regression_report.json has no step_time_regression entry")
+    entry = entries[0]
+    diff = entry.get("diff")
+    if not diff:
+        fail(f"regression entry carries no diff: {entry}")
+    if not diff.get("significant"):
+        fail(f"the injected slowdown diffed as insignificant: {diff}")
+    if diff.get("top_growing_component") != "data_wait":
+        fail("diff blamed "
+             f"{diff.get('top_growing_component')!r}, not data_wait")
+    if not any(s.get("name") == "input.next"
+               for s in diff.get("top_growing_spans") or []):
+        fail(f"diff did not name the injected span: "
+             f"{diff.get('top_growing_spans')}")
+    if not entry.get("capture") or \
+            entry["capture"].get("report") not in alert_reports:
+        fail(f"regression entry is not linked to the capture: {entry}")
+
+    # 4. the manual /capturez leg answered ok mid-run
+    resp = scraper.capturez_response
+    with open(os.path.join(out_dir, "capturez-response.json"),
+              "w") as f:
+        json.dump(resp, f, indent=2)
+    if not resp or resp.get("ok") is not True:
+        fail(f"POST /capturez?rank=0 did not answer ok: {resp}")
+    manual = {n: r for n, r in reports.items()
+              if r.get("reason") == "manual"}
+    if not any(r.get("rank") == 0 for r in manual.values()):
+        fail(f"no rank-0 manual capture artifact (found: "
+             f"{sorted(reports)})")
+
+    # 5a. observe.top renders the live captures block when caught
+    if scraper.captures_doc is not None:
+        from sparkdl_tpu.observe.top import render
+
+        frame = render(scraper.captures_doc)
+        with open(os.path.join(out_dir, "top-frame.txt"), "w") as f:
+            f.write(frame + "\n")
+        if "profile captures:" not in frame:
+            fail(f"observe.top dropped the captures block:\n{frame}")
+        print("---- observe.top frame (mid-run, with captures) ----")
+        print(frame)
+
+    # 5b. the doctor renders the forensics section, artifact-only
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkdl_tpu.observe.doctor", run_dir],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    with open(os.path.join(out_dir, "doctor-report.txt"), "w") as f:
+        f.write(proc.stdout + proc.stderr)
+    if proc.returncode != 0:
+        fail(f"doctor exited {proc.returncode} (a slow rank is not a "
+             f"hang):\n{proc.stdout}\n{proc.stderr}")
+    for needle in ("perf forensics", "data_wait", "grew the most"):
+        if needle not in proc.stdout:
+            fail(f"doctor output is missing {needle!r}:\n{proc.stdout}")
+
+    print("FORENSICS SMOKE PASSED: the starved rank tripped "
+          "step_time_regression, the capture landed on rank 1 only, "
+          "regression_report.json blamed data_wait/input.next and "
+          "linked the artifact, the manual /capturez leg captured "
+          "rank 0, and the doctor rendered the forensics section.")
+
+
+if __name__ == "__main__":
+    main()

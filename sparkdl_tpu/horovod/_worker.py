@@ -108,6 +108,7 @@ def worker_io(rank, local_log_path=None):
         client.start_driver_watchdog()
     heartbeat = None
     flightrec = None
+    capture = None
     if client is not None and observe.enabled():
         # Telemetry transport: periodic batched flushes of this
         # worker's metric snapshot + timeline events over the control
@@ -146,6 +147,17 @@ def worker_io(rank, local_log_path=None):
         from sparkdl_tpu.observe import mem
 
         mem.maybe_start_sampler()
+        # Perf forensics: answer the driver's PROFILE_REQ frames (and
+        # the fixed-step self-trigger) with bounded capture windows —
+        # xprof trace + uncapped attribution rows into the job dir.
+        # Installed AFTER the flight recorder so its timeline tap
+        # chains over the recorder's mirror; None without a job dir
+        # (sparkdl_tpu.observe.capture).
+        from sparkdl_tpu.observe.capture import (
+            maybe_start_capture_service,
+        )
+
+        capture = maybe_start_capture_service(client, rank)
         observe.instant("worker.start", cat="worker", rank=rank)
     _set_parent_death_signal()
     local_log = (
@@ -176,6 +188,11 @@ def worker_io(rank, local_log_path=None):
         sys.stdout, sys.stderr = orig_stdout, orig_stderr
         if client is not None:
             if observe.enabled():
+                if capture is not None:
+                    # BEFORE the flight recorder teardown below: the
+                    # capture tap chains over the recorder's mirror
+                    # and must restore it cleanly.
+                    capture.stop()
                 if heartbeat is not None:
                     heartbeat.stop()
                 from sparkdl_tpu.observe import mem

@@ -46,6 +46,8 @@ MSG_TELEMETRY = 10    # observe: batched metric snapshot + timeline events
 MSG_HEARTBEAT = 11    # observe.health: per-rank liveness beacon
 MSG_DUMP_REQ = 12     # driver→worker: send an all-thread stack dump
 MSG_STACK_DUMP = 13   # worker→driver: the faulthandler dump text
+MSG_PROFILE_REQ = 14  # driver→worker: capture a perf-forensics window
+MSG_PROFILE_DONE = 15  # worker→driver: capture finished (report meta)
 
 _HEADER = struct.Struct(">IBI")  # length (of type+rank+payload), type, rank
 
@@ -56,7 +58,8 @@ _MSG_NAMES = {
     MSG_RESULT: "RESULT", MSG_EXC: "EXC", MSG_BYE: "BYE",
     MSG_AUTH: "AUTH", MSG_RESULT_PART: "RESULT", MSG_RESULT_END: "RESULT",
     MSG_TELEMETRY: "TELEMETRY", MSG_HEARTBEAT: "HEARTBEAT",
-    MSG_STACK_DUMP: "STACK_DUMP",
+    MSG_STACK_DUMP: "STACK_DUMP", MSG_PROFILE_REQ: "PROFILE_REQ",
+    MSG_PROFILE_DONE: "PROFILE_DONE",
 }
 
 CONTROL_ADDR_ENV = "SPARKDL_TPU_CONTROL_ADDR"
@@ -165,6 +168,11 @@ class ControlPlaneServer:
         # must ride the main socket the watchdog reads).
         self._conns = {}
         self._stack_dumps = {}  # rank -> [dump text, ...]
+        self._profile_reports = {}  # rank -> [report meta dict, ...]
+        # Optional observer for PROFILE_DONE frames (the forensics
+        # manager clears its in-flight latch here); called OUTSIDE the
+        # server lock with (rank, report_meta_dict).
+        self.on_profile_done = None
         # Per-job shared secret; the launcher ships it to workers via
         # CONTROL_SECRET_ENV. Auto-generated so no caller can forget it.
         self.secret = secret or _secrets.token_hex(32)
@@ -403,6 +411,22 @@ class ControlPlaneServer:
                 )
             if self._health is not None:
                 self._health.note_stack_dump(rank)
+        elif mtype == MSG_PROFILE_DONE:
+            msg = json.loads(payload.decode("utf-8", "replace"))
+            if not isinstance(msg, dict):
+                msg = {}
+            with self._lock:
+                self._profile_reports.setdefault(rank, []).append(msg)
+                if self._log_file is not None:
+                    self._log_file.write(
+                        f"[rank {rank} PROFILE DONE "
+                        f"({msg.get('reason', 'requested')}) "
+                        f"{msg.get('report') or ''}]\n"
+                    )
+            cb = self.on_profile_done
+            if cb is not None:
+                # outside the lock: the forensics manager takes its own
+                cb(rank, msg)
         elif mtype == MSG_EXC:
             msg = json.loads(payload.decode("utf-8", "replace"))
             with self._lock:
@@ -466,6 +490,42 @@ class ControlPlaneServer:
         except OSError:
             return False
         return True
+
+    def request_profile(self, rank, reason="alert", rule=None,
+                        steps=None):
+        """Ask ``rank`` to capture a perf-forensics evidence window
+        (xprof trace + uncapped attribution rows + memory snapshot)
+        into its job dir. Same transport contract as
+        :meth:`request_dump`: the guaranteed control socket, where the
+        worker's framed watchdog dispatches it to the registered
+        capture service. Returns False (never raises) when the rank
+        has no registered connection or the send fails."""
+        with self._lock:
+            conn = self._conns.get(rank)
+        if conn is None:
+            return False
+        req = {"reason": reason}
+        if rule is not None:
+            req["rule"] = rule
+        if steps is not None:
+            req["steps"] = int(steps)
+        payload = json.dumps(req).encode("utf-8")
+        frame = _HEADER.pack(
+            len(payload) + 5, MSG_PROFILE_REQ, rank) + payload
+        try:
+            conn.sendall(frame)
+        except OSError:
+            return False
+        return True
+
+    def profile_reports(self, rank=None):
+        """PROFILE_DONE report metadata: ``{rank: [meta, ...]}``, or
+        the list for one rank."""
+        with self._lock:
+            if rank is not None:
+                return list(self._profile_reports.get(rank, ()))
+            return {r: list(d)
+                    for r, d in self._profile_reports.items()}
 
     def stack_dumps(self, rank=None):
         """Collected stack-dump texts: ``{rank: [dump, ...]}``, or the
@@ -542,6 +602,11 @@ class ControlPlaneClient:
             pass  # non-Linux: keepalive is best-effort
         self._lock = threading.Lock()
         self._closing = False
+        # Perf-forensics capture hook (sparkdl_tpu.observe.capture):
+        # None unless a capture service registered — the watchdog's
+        # PROFILE_REQ dispatch is inert with telemetry off (the
+        # zero-overhead latch extends to forensics).
+        self._profile_handler = None
         self._native = None
         if os.environ.get("SPARKDL_TPU_NATIVE_LOGS", "1") != "0":
             try:
@@ -666,6 +731,42 @@ class ControlPlaneClient:
                     + traceback.format_exc())
         self._send_json(MSG_STACK_DUMP, {"reason": reason, "dump": dump})
 
+    def set_profile_handler(self, handler):
+        """Register the worker-side capture service's entry point for
+        driver ``PROFILE_REQ`` frames (``handler(request_dict)``,
+        called on the watchdog thread — it must delegate the capture
+        itself to its own thread, a capture spans many steps of wall
+        time and the watchdog is the driver-death detector). ``None``
+        unregisters."""
+        self._profile_handler = handler
+
+    def send_profile_done(self, report_meta):
+        """Answer a ``PROFILE_REQ``: JSON metadata about the finished
+        (or failed) capture — report filename, trace dir, reason/rule,
+        error. Rides the guaranteed control socket like
+        ``STACK_DUMP``."""
+        self._send_json(MSG_PROFILE_DONE, report_meta)
+
+    def _dispatch_profile_request(self, payload):
+        """Hand one PROFILE_REQ to the registered capture service;
+        without one (telemetry off, or no service started) the frame
+        is dropped — never an error, never any work."""
+        handler = self._profile_handler
+        if handler is None:
+            return
+        try:
+            req = json.loads(payload.decode("utf-8", "replace"))
+        except ValueError:
+            req = {}
+        if not isinstance(req, dict):
+            req = {}
+        try:
+            handler(req)
+        except Exception:
+            # the watchdog must keep watching no matter what the
+            # capture service does
+            pass
+
     def start_driver_watchdog(self, grace_seconds=10.0):
         """Exit this worker when the driver disappears; answer its
         hang-diagnosis requests meanwhile.
@@ -691,6 +792,9 @@ class ControlPlaneClient:
                             if payload is not None:
                                 if mtype == MSG_DUMP_REQ:
                                     self._answer_dump_request(payload)
+                                elif mtype == MSG_PROFILE_REQ:
+                                    self._dispatch_profile_request(
+                                        payload)
                                 continue  # keep watching
                         # unframeable driver bytes: treat like a reset
                     head = None

@@ -488,6 +488,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
     # retry budget leaves its telemetry behind for the postmortem.
     telemetry = None
     alert_engine = None
+    forensics = None
     if observe.enabled():
         from sparkdl_tpu.observe.aggregate import GangTelemetry
 
@@ -504,6 +505,16 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
         from sparkdl_tpu.observe.alerts import maybe_make_engine
 
         alert_engine = maybe_make_engine(telemetry)
+        # Perf forensics (ISSUE 20): alert-triggered / on-demand
+        # capture orchestration + regression_report.json. One manager
+        # spans attempts like the alert engine; each attempt rebinds
+        # it to its control plane (bind_server). The ON_ALERT knob
+        # gates only the alert hook — manual /capturez works on any
+        # telemetry-on gang.
+        from sparkdl_tpu.observe.forensics import maybe_make_forensics
+
+        forensics = maybe_make_forensics(
+            telemetry, alert_engine=alert_engine)
     # Autonomous elasticity (ISSUE 16; SPARKDL_TPU_ELASTIC): the
     # capacity watcher / chip-budget arbiter also spans every attempt.
     # It is consulted by the supervisor for relaunch targets via the
@@ -523,6 +534,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
                 np, main, kwargs, driver_log_verbosity, per_rank_kwargs,
                 extra_env=extra_env, telemetry=telemetry,
                 alert_engine=alert_engine, controller=controller,
+                forensics=forensics,
             ),
             RetryPolicy.from_env(),
         )
@@ -567,7 +579,7 @@ def launch_gang(np, main, kwargs, driver_log_verbosity, per_rank_kwargs=None):
 def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                       per_rank_kwargs=None, extra_env=None,
                       telemetry=None, alert_engine=None,
-                      controller=None):
+                      controller=None, forensics=None):
     import cloudpickle
 
     from sparkdl_tpu import observe
@@ -738,7 +750,8 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
 
             statusz = maybe_start_statusz(
                 telemetry, detector=detector, num_workers=num_workers,
-                alerts=alert_engine, elastic=controller)
+                alerts=alert_engine, elastic=controller,
+                forensics=forensics)
             if statusz is not None:
                 logger.info("statusz live at http://%s/statusz",
                             statusz.address)
@@ -799,6 +812,11 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
             telemetry=telemetry,
             health=detector,
         )
+        if forensics is not None:
+            # PROFILE_REQ frames go out through THIS attempt's control
+            # plane; its PROFILE_DONE callback clears the per-rank
+            # in-flight latch.
+            forensics.bind_server(server)
         # jax.distributed's coordinator lives in RANK 0, so the
         # rendezvous address must name rank 0's host, reachable from
         # every worker. Operators behind NAT/DNS oddities can pin it.
@@ -1007,8 +1025,14 @@ def _launch_gang_once(np, main, kwargs, driver_log_verbosity,
                 # (throttled internally to its check cadence). Firings
                 # land as alert.* instants + gang_alerts_total here;
                 # the merged report is attached to the run dir in
-                # launch_gang's finally.
-                alert_engine.poll()
+                # launch_gang's finally. Perf-rule firings also feed
+                # the forensics hook: with SPARKDL_TPU_PROFILE_ON_ALERT
+                # set, the offending rank is told to capture a profile
+                # window and the baseline-vs-regressed diff lands in
+                # regression_report.json.
+                fired = alert_engine.poll()
+                if forensics is not None and fired:
+                    forensics.on_alerts(fired)
             if controller is not None and first_death is None:
                 # Elastic tick (throttled internally): capacity watch,
                 # debounce, arbiter. A non-None return means a planned

@@ -48,6 +48,7 @@ COMMS_FILE = "comms_report.json"
 FIXIT_FILE = "fixit_report.json"
 ALERTS_FILE = "alerts.json"
 ELASTIC_FILE = "elastic.json"
+REGRESSION_FILE = "regression_report.json"
 
 # Live event journal bound: the statusz SSE tail and the alert
 # engine's rolling windows only ever need the recent past, so the
@@ -80,6 +81,7 @@ class GangTelemetry:
         self._fixit_reports = []    # verified fixit reports (pre-flight)
         self._alert_reports = []    # one alert-engine report per attempt
         self._elastic_reports = []  # elastic-controller decision logs
+        self._regression_reports = []  # perf-forensics diff entries
         # Live journal: every ingested worker event, in arrival order,
         # with a monotonically increasing seq — the feed behind the
         # statusz `/events` SSE tail and the alert engine's rolling
@@ -183,6 +185,17 @@ class GangTelemetry:
         if isinstance(report, dict):
             with self._lock:
                 self._elastic_reports.append(report)
+
+    def add_regression_report(self, entry):
+        """One perf-forensics entry from the driver-side forensics
+        manager (:mod:`sparkdl_tpu.observe.forensics`): the
+        ``diff_attribution`` document for a fired perf alert plus the
+        trigger/capture metadata. Entries accumulate across attempts
+        like alert reports and are written to
+        ``regression_report.json`` beside ``alerts.json``."""
+        if isinstance(entry, dict):
+            with self._lock:
+                self._regression_reports.append(entry)
 
     # -- live views (statusz / alert engine) ---------------------------------
 
@@ -366,6 +379,7 @@ class GangTelemetry:
             fixit = list(self._fixit_reports)
             alert_reports = list(self._alert_reports)
             elastic_reports = list(self._elastic_reports)
+            regression_reports = list(self._regression_reports)
         if elastic_reports:
             # Same merge shape as alerts: newest config/state wins,
             # decisions concatenate across reports.
@@ -383,6 +397,10 @@ class GangTelemetry:
                                 for a in rep.get("alerts", ())]
             merged["attempts"] = len(alert_reports)
             files.append((ALERTS_FILE, json.dumps(merged, indent=2)))
+        if regression_reports:
+            files.append((REGRESSION_FILE, json.dumps(
+                {"schema": _perf.REGRESSION_SCHEMA,
+                 "reports": regression_reports}, indent=2)))
         if comms:
             files.append((COMMS_FILE, json.dumps(
                 {"reports": comms}, indent=2)))
@@ -431,6 +449,26 @@ class GangTelemetry:
                         files.append((os.path.basename(src), f.read()))
                 except Exception:
                     continue
+        # Perf-forensics evidence: capture services write
+        # profile_report-rank-*.json (uncapped attribution windows)
+        # and xprof-rank-*/ trace dirs into their job dir; recover
+        # both into the merged run dir where the doctor (and an
+        # operator's tensorboard) look. Same never-fatal stance.
+        trace_dirs = []
+        for job_dir in job_dirs:
+            try:
+                reports = _glob.glob(
+                    os.path.join(job_dir, "profile_report*.json"))
+                trace_dirs.extend(
+                    _glob.glob(os.path.join(job_dir, "xprof-rank-*")))
+            except Exception:
+                continue
+            for src in sorted(reports):
+                try:
+                    with open(src) as f:
+                        files.append((os.path.basename(src), f.read()))
+                except Exception:
+                    continue
         if health:
             files.append(
                 (HEALTH_FILE, json.dumps({"attempts": health}, indent=2))
@@ -443,4 +481,15 @@ class GangTelemetry:
                 f.write(text)
             os.replace(tmp, path)
             paths[name] = path
+        import shutil as _shutil
+
+        for src in sorted(trace_dirs):
+            if not os.path.isdir(src):
+                continue
+            dst = os.path.join(out_dir, os.path.basename(src))
+            try:
+                _shutil.copytree(src, dst, dirs_exist_ok=True)
+                paths[os.path.basename(src)] = dst
+            except Exception:
+                continue
         return paths

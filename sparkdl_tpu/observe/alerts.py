@@ -263,6 +263,12 @@ class AlertEngine:
         explicit = _env_float(env, STEP_BASELINE_ENV, None)
         self._baseline_source = "env" if explicit is not None else None
         self._baselines = {}          # rank -> baseline seconds
+        # rank -> the telemetry events of the window that (last)
+        # calibrated that rank's baseline — the healthy past the perf
+        # forensics differ uses as the baseline side of
+        # diff_attribution, so a regression report explains exactly
+        # the regression that fired. Empty for env/ledger baselines.
+        self._baseline_windows = {}
         self._explicit_baseline = explicit
         if explicit is None:
             ledger = self._ledger_baseline()
@@ -299,6 +305,7 @@ class AlertEngine:
         # Explicit env / ledger baselines are world-independent and
         # survive untouched (``_explicit_baseline`` is not cleared).
         self._baselines.clear()
+        self._baseline_windows.clear()
         if self._baseline_source == "self":
             self._baseline_source = None
         for latch in [k for k in self._fired
@@ -334,6 +341,13 @@ class AlertEngine:
         if self._explicit_baseline is not None:
             return self._explicit_baseline
         return self._baselines.get(rank)
+
+    def baseline_window(self, rank):
+        """The telemetry events the rank's current self-calibrated
+        baseline was computed from — the healthy-past side that
+        ``perf.diff_attribution`` compares a regressed window against.
+        Empty when the baseline came from env/ledger (no window)."""
+        return list(self._baseline_windows.get(rank) or ())
 
     # -- the poll ------------------------------------------------------------
 
@@ -413,11 +427,15 @@ class AlertEngine:
                 # First qualifying window calibrates; later windows
                 # only ever lower it (the run's healthy floor).
                 self._baselines[rank] = med
+                self._baseline_windows[rank] = list(
+                    ctx["events"].get(rank) or ())
                 if self._baseline_source is None:
                     self._baseline_source = "self"
                 continue
             if self._explicit_baseline is None and med < base:
                 self._baselines[rank] = med
+                self._baseline_windows[rank] = list(
+                    ctx["events"].get(rank) or ())
                 continue
             if med > self.step_factor * base:
                 out.append((rank, {

@@ -1,0 +1,335 @@
+"""Worker-side perf-forensics capture service + manual trigger CLI.
+
+The worker half of the perf-forensics round trip
+(:mod:`sparkdl_tpu.observe.forensics` is the driver half): when the
+driver sends ``MSG_PROFILE_REQ`` down this rank's control socket (a
+perf alert fired, or an operator POSTed ``/capturez``), the service
+captures a bounded evidence window into the job dir and answers
+``MSG_PROFILE_DONE`` — the same framed-watchdog request/response
+pattern as the hang-diagnosis ``MSG_DUMP_REQ`` stack dumps.
+
+One capture window produces three artifacts:
+
+- an xprof trace of the window (``xprof-rank-<N>-<seq>/``) via
+  :class:`sparkdl_tpu.utils.jax_compat.profiler_trace` — best-effort,
+  absent on processes that never imported jax;
+- ``profile_report-rank-<N>-<seq>.json``: UNCAPPED per-step
+  attribution rows for the window (the run-dir ``perf.json`` caps its
+  tail at 200 rows; forensic evidence must not), plus a device-memory
+  snapshot and the trigger metadata;
+- a ``profile.capture.*`` instant pair in the timeline.
+
+The window is bounded two ways: it ends after
+``SPARKDL_TPU_PROFILE_STEPS`` instrumented train steps OR after a
+wall-clock cap, whichever comes first — so a hung step cannot pin a
+profiler session forever. At most ONE capture runs at a time per rank
+(a flapping alert cannot stack profiler sessions; the driver enforces
+its own per-rank in-flight latch on top).
+
+Event collection taps the timeline's observer slot, CHAINING to the
+flight recorder already installed there — it never drains the shared
+timeline (the telemetry flusher owns draining). The same tap counts
+train steps continuously, which is what implements the fixed-step A/B
+trigger ``SPARKDL_TPU_PROFILE_AT_STEP`` without a second thread.
+
+Zero-overhead contract: the service only exists inside
+``worker_io``'s telemetry-latched block — telemetry-off runs construct
+no object, read no knob, install no observer.
+
+CLI (the manual trigger, third trigger path)::
+
+    python -m sparkdl_tpu.observe.capture http://driver:8080 [rank]
+
+POSTs ``/capturez`` on the driver's statusz endpoint and prints the
+JSON response.
+"""
+
+import json
+import os
+import threading
+import time
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.utils import jax_compat, knobs
+
+CAPTURE_SCHEMA = "sparkdl_tpu.observe.capture/1"
+
+PROFILE_STEPS_ENV = "SPARKDL_TPU_PROFILE_STEPS"
+PROFILE_AT_STEP_ENV = "SPARKDL_TPU_PROFILE_AT_STEP"
+DEFAULT_PROFILE_STEPS = 20
+# Wall-clock cap on one capture window: a wedged step must release the
+# profiler session even though the step counter never advances (the
+# hang detector owns diagnosing the wedge itself).
+DEFAULT_MAX_WINDOW_S = 120.0
+
+
+def report_name(rank, seq):
+    return f"profile_report-rank-{rank}-{seq}.json"
+
+
+def trace_dir_name(rank, seq):
+    return f"xprof-rank-{rank}-{seq}"
+
+
+class CaptureService:
+    """Per-worker forensic capture: answers the driver's PROFILE_REQ
+    frames (and the fixed-step self-trigger) with bounded evidence
+    windows written into ``job_dir``."""
+
+    def __init__(self, client, rank, job_dir, *,
+                 steps=None, max_window_s=DEFAULT_MAX_WINDOW_S,
+                 env=None):
+        self._client = client
+        self._rank = int(rank)
+        self._job_dir = job_dir
+        self._default_steps = (
+            steps if steps is not None
+            else knobs.read_int(PROFILE_STEPS_ENV,
+                                DEFAULT_PROFILE_STEPS, env=env))
+        self._at_step = knobs.read_int(PROFILE_AT_STEP_ENV, env=env)
+        self._at_fired = False
+        self._max_window_s = float(max_window_s)
+        self._lock = threading.Lock()
+        self._capturing = False
+        self._thread = None
+        self._seq = 0
+        self._prev_observer = None
+        self._installed = False
+        # Live capture window state, touched by the tap (timeline
+        # recording threads) and the capture thread. ``_buf`` doubles
+        # as the capturing latch the tap reads: None = no window open.
+        self._buf = None
+        self._buf_steps = 0
+        self._want_steps = 0
+        self._steps_total = 0
+        self._done = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self):
+        """Install the timeline tap (chained over the flight-recorder
+        mirror) and register for the driver's PROFILE_REQ frames."""
+        tl = observe.timeline()
+        self._prev_observer = tl.observer
+        tl.observer = self._tap
+        self._installed = True
+        if self._client is not None:
+            self._client.set_profile_handler(self._on_request)
+        return self
+
+    def stop(self, join_timeout=5.0):
+        """Unregister, restore the previous observer, and release any
+        in-flight capture window (it finalizes with whatever it has).
+        Call BEFORE the flight recorder is torn down so the chain
+        restores cleanly."""
+        if self._client is not None:
+            self._client.set_profile_handler(None)
+        tl = observe.timeline()
+        # == not `is`: each self._tap access builds a fresh bound
+        # method, so identity never matches the one install() stored
+        if self._installed and tl.observer == self._tap:
+            tl.observer = self._prev_observer
+        self._installed = False
+        self._done.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=join_timeout)
+
+    # -- the timeline tap (runs on recording threads) -----------------
+
+    def _tap(self, ev):
+        prev = self._prev_observer
+        if prev is not None:
+            try:
+                prev(ev)
+            except Exception:
+                pass  # the chained mirror must never break the tap
+        is_step = (
+            ev.get("ph") == "X" and ev.get("cat") == "train"
+            and (ev.get("args") or {}).get("phase") != "compile")
+        if is_step:
+            self._steps_total += 1
+            if (self._at_step is not None and not self._at_fired
+                    and self._steps_total >= self._at_step):
+                self._at_fired = True
+                self.trigger(reason="at_step")
+        if self._buf is None:  # lock-free fast path: no window open
+            return
+        with self._lock:
+            buf = self._buf
+            if buf is None:  # closed while we raced for the lock
+                return
+            buf.append(ev)
+            if is_step:
+                self._buf_steps += 1
+                if self._buf_steps >= self._want_steps:
+                    # Quota reached: the TAP closes the window, not
+                    # the capture thread — that thread can be stuck
+                    # seconds inside jax.profiler.start_trace (slow
+                    # first-use init), and evidence recorded past the
+                    # quota would make the report size depend on
+                    # profiler startup lag.
+                    self._buf = None
+                    self._done.set()
+
+    # -- triggers -----------------------------------------------------
+
+    def _on_request(self, req):
+        """PROFILE_REQ handler — runs on the client watchdog thread,
+        so it only spawns; the capture itself runs on its own thread."""
+        if not isinstance(req, dict):
+            req = {}
+        self.trigger(reason=req.get("reason") or "alert",
+                     rule=req.get("rule"), steps=req.get("steps"))
+
+    def trigger(self, reason="manual", rule=None, steps=None):
+        """Start one capture window unless one is already in flight
+        (single-in-flight: a flapping trigger is dropped with an
+        instant, never queued). Returns True when a capture started."""
+        with self._lock:
+            if self._capturing:
+                observe.instant(
+                    "profile.capture.skipped", cat="profile",
+                    rank=self._rank, reason=reason,
+                    **({"rule": rule} if rule else {}))
+                return False
+            self._capturing = True
+            seq = self._seq
+            self._seq += 1
+        t = threading.Thread(
+            target=self._capture, args=(reason, rule, steps, seq),
+            name="sparkdl-tpu-profile-capture", daemon=True)
+        self._thread = t
+        t.start()
+        return True
+
+    # -- the capture window (its own thread) --------------------------
+
+    def _capture(self, reason, rule, steps, seq):
+        try:
+            want = int(steps) if steps else self._default_steps
+            want = max(1, want)
+            rank = self._rank
+            trace_name = trace_dir_name(rank, seq)
+            observe.instant(
+                "profile.capture.start", cat="profile", rank=rank,
+                reason=reason, steps=want,
+                **({"rule": rule} if rule else {}))
+            t0 = time.time()
+            buf = []
+            self._done.clear()
+            # The event window opens NOW, before the profiler session:
+            # start_trace can spend seconds initializing on first use,
+            # and the attribution evidence must cover the steps right
+            # after the trigger, not whatever ran after the profiler
+            # finally came up. The tap closes the window at the step
+            # quota; the xprof trace is best-effort alongside.
+            with self._lock:
+                self._buf_steps = 0
+                self._want_steps = want
+                self._buf = buf
+            traced = None
+            try:
+                with jax_compat.profiler_trace(
+                        os.path.join(self._job_dir, trace_name)) as traced:
+                    self._done.wait(self._max_window_s)
+            finally:
+                with self._lock:  # wall-cap / teardown close
+                    self._buf = None
+                    steps_captured = self._buf_steps
+            window_s = time.time() - t0
+            events = list(buf)
+            from sparkdl_tpu.observe import perf
+
+            report = {
+                "schema": CAPTURE_SCHEMA,
+                "rank": rank,
+                "reason": reason,
+                "rule": rule,
+                "ts": t0,
+                "window_s": window_s,
+                "requested_steps": want,
+                "steps_captured": steps_captured,
+                # Uncapped: every step row of the window survives
+                # (perf.json's 200-row cap does not apply to forensic
+                # evidence).
+                "attribution": perf.attribution_report(events),
+                "device_memory": jax_compat.device_memory_stats(),
+                "trace_dir": trace_name if traced else None,
+            }
+            fname = report_name(rank, seq)
+            path = os.path.join(self._job_dir, fname)
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(report, f, indent=2, sort_keys=True)
+                os.replace(tmp, path)
+            except OSError:
+                fname = None  # unwritable dir: the DONE frame still goes
+            observe.instant(
+                "profile.capture.done", cat="profile", rank=rank,
+                reason=reason, steps=steps_captured,
+                window_s=round(window_s, 3),
+                **({"rule": rule} if rule else {}))
+            if self._client is not None:
+                self._client.send_profile_done({
+                    "rank": rank,
+                    "reason": reason,
+                    "rule": rule,
+                    "report": fname,
+                    "trace_dir": report["trace_dir"],
+                    "steps_captured": steps_captured,
+                    "window_s": window_s,
+                })
+        finally:
+            with self._lock:
+                self._capturing = False
+
+
+def maybe_start_capture_service(client, rank, env=None):
+    """The latched factory ``worker_io`` calls inside its telemetry
+    block: a started :class:`CaptureService` when telemetry is on and
+    this worker has a job dir to write evidence into, else None — no
+    object, no observer, no knob read."""
+    if client is None or not observe.enabled():
+        return None
+    env = os.environ if env is None else env
+    job_dir = env.get("SPARKDL_TPU_JOB_DIR")
+    if not job_dir:
+        return None
+    return CaptureService(client, rank, job_dir, env=env).start()
+
+
+# -- manual trigger CLI -----------------------------------------------
+
+
+def main(argv=None):
+    import sys
+    import urllib.error
+    import urllib.request
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m sparkdl_tpu.observe.capture "
+              "http://driver:port [rank]", file=sys.stderr)
+        return 2
+    url = argv[0].rstrip("/") + "/capturez"
+    if len(argv) > 1:
+        url += f"?rank={int(argv[1])}"
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = resp.read().decode("utf-8", "replace")
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode("utf-8", "replace")
+        code = e.code
+    except OSError as e:
+        print(f"capture request failed: {e}", file=sys.stderr)
+        return 1
+    print(body)
+    return 0 if 200 <= code < 300 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,15 @@ level names the gate's own ``decision`` (``ok`` | ``regression`` |
 ``regression-advisory`` | ``no-overlap``) plus the ``exit_code`` it
 implies, so a consumer never re-derives the cross-host/no-overlap
 rules.
+
+``--explain`` answers the next question a failing gate raises — *why*
+is the candidate slower: when the verdict is not ``ok`` and both
+sides are telemetry run dirs, the per-rank differential step
+attribution (:func:`sparkdl_tpu.observe.perf.diff_attribution`, the
+same core the alert-triggered forensics report uses) is appended —
+per-component deltas, overlap-efficiency/MFU movement and the
+top-growing span names, from each side's timeline (or the capped
+``perf.json`` rows when the timeline is gone).
 """
 
 import argparse
@@ -223,6 +232,59 @@ def load_record(spec):
     return _from_bench_json(doc)
 
 
+# -- the --explain diff ------------------------------------------------------
+
+
+def _explain_windows(path):
+    """rank -> diffable window for one run-dir side: the raw timeline
+    events by lane when ``timeline.json`` survived (lane ``rank + 1``
+    is rank ``r``, span names available — full-fidelity diff), else
+    the capped per-step rows out of ``perf.json`` (component deltas
+    still work; grown spans cannot be named)."""
+    try:
+        with open(os.path.join(path, "timeline.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = None
+    out = {}
+    for e in (doc or {}).get("traceEvents", ()):
+        pid = e.get("pid") if isinstance(e, dict) else None
+        if isinstance(pid, int) and pid >= 1 and e.get("ph") != "M":
+            out.setdefault(str(pid - 1), []).append(e)
+    if out:
+        return out
+    try:
+        with open(os.path.join(path, "perf.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    for rank_s, rep in ((doc or {}).get("ranks") or {}).items():
+        rows = (rep or {}).get("per_step")
+        if rows:
+            out[str(rank_s)] = list(rows)
+    return out
+
+
+def explain_run_dirs(base_path, cand_path):
+    """The ``--explain`` core: per-rank
+    :func:`~sparkdl_tpu.observe.perf.diff_attribution` between two run
+    dirs (base = the healthy run, candidate = the regressed one) —
+    the SAME differential the alert-triggered forensics report writes,
+    so the gate's "why" and the live incident's "why" read alike.
+    Ranks with no attributable window on either side are skipped."""
+    from sparkdl_tpu.observe import perf
+
+    base_w = _explain_windows(base_path)
+    cand_w = _explain_windows(cand_path)
+    out = {}
+    for rank_s in sorted(set(base_w) & set(cand_w),
+                         key=lambda r: (len(r), r)):
+        diff = perf.diff_attribution(base_w[rank_s], cand_w[rank_s])
+        if diff is not None:
+            out[rank_s] = diff
+    return out
+
+
 # -- comparison --------------------------------------------------------------
 
 
@@ -325,6 +387,10 @@ def main(argv=None):
     parser.add_argument("--strict-host", action="store_true",
                         help="enforce regressions even across "
                         "different host fingerprints")
+    parser.add_argument("--explain", action="store_true",
+                        help="on a failing verdict between two run "
+                        "dirs, append the per-rank differential step "
+                        "attribution (why the candidate is slower)")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
     args = parser.parse_args(argv)
@@ -352,10 +418,29 @@ def main(argv=None):
     report.update({"decision": decision, "exit_code": rc,
                    "floor": args.floor, "iqr_k": args.iqr_k,
                    "strict_host": bool(args.strict_host)})
+    explain = None
+    if (args.explain and decision != "ok"
+            and os.path.isdir(args.base)
+            and os.path.isdir(args.candidate)):
+        explain = explain_run_dirs(args.base, args.candidate)
+        report["explain"] = explain
     if args.format == "json":
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
-        print(render_text(report))
+        text = render_text(report)
+        if explain:
+            from sparkdl_tpu.observe.perf import render_diff_lines
+
+            lines = ["why (differential step attribution, base -> "
+                     "candidate):"]
+            for rank_s, diff in explain.items():
+                lines.append(f"  rank {rank_s}:")
+                lines.extend(render_diff_lines(diff, indent="    "))
+            text += "\n" + "\n".join(lines)
+        elif explain is not None:
+            text += ("\nwhy: no attributable step windows on both "
+                     "sides — nothing to diff")
+        print(text)
     return rc
 
 

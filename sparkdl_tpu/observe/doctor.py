@@ -255,6 +255,49 @@ def _diagnose_alerts(run_dir):
     }
 
 
+def _diagnose_forensics(run_dir):
+    """Perf-forensics section (or None when no capture ever ran): the
+    ``regression_report.json`` the forensics manager wrote — one entry
+    per trigger, each carrying the differential attribution between
+    the alert rule's own calibration window and the window that fired
+    — plus every worker-side ``profile_report-rank-*.json`` capture
+    (bounded profile window: uncapped attribution rows, device-memory
+    snapshot, xprof trace dir) the aggregator recovered from the job
+    dirs. Artifact-only like everything else here: the diff is
+    rendered from the stored doc, never recomputed."""
+    doc = _load_json(os.path.join(run_dir, "regression_report.json"))
+    reports = [r for r in (doc or {}).get("reports", ())
+               if isinstance(r, dict)]
+    captures = []
+    for p in sorted(glob.glob(os.path.join(run_dir,
+                                           "profile_report*.json"))):
+        rep = _load_json(p)
+        if not isinstance(rep, dict):
+            continue
+        attribution = rep.get("attribution") or {}
+        captures.append({
+            "file": os.path.basename(p),
+            "rank": rep.get("rank"),
+            "reason": rep.get("reason"),
+            "rule": rep.get("rule"),
+            "steps_captured": rep.get("steps_captured"),
+            "window_s": rep.get("window_s"),
+            "trace_dir": rep.get("trace_dir"),
+            "attribution_steps": attribution.get("steps"),
+            "fractions": attribution.get("fractions"),
+            "overlap_efficiency": attribution.get("overlap_efficiency"),
+            "device_memory": rep.get("device_memory") or None,
+        })
+    trace_dirs = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(run_dir, "xprof-rank-*"))
+        if os.path.isdir(p))
+    if not reports and not captures and not trace_dirs:
+        return None
+    return {"reports": reports, "captures": captures,
+            "trace_dirs": trace_dirs}
+
+
 def _diagnose_elastic(run_dir):
     """Elastic-controller section (or None when the run predates
     autonomous elasticity / never enabled it): the ``elastic.json``
@@ -555,6 +598,7 @@ def diagnose(run_dir):
         "serving": _diagnose_serving(events, by_rank),
         "memory": _diagnose_memory(run_dir, by_rank, health),
         "alerts": _diagnose_alerts(run_dir),
+        "forensics": _diagnose_forensics(run_dir),
         "elastic": _diagnose_elastic(run_dir),
         "perf": _diagnose_perf(run_dir, events, by_rank),
         "comms": _diagnose_comms(run_dir, by_rank),
@@ -702,6 +746,50 @@ def render_text(diag):
             if isinstance(wait, (int, float)) and wait > 0.0005:
                 line += f"; +{wait:.3f}s data wait between steps"
             lines.append(line)
+    forensics = diag.get("forensics")
+    if forensics:
+        from sparkdl_tpu.observe.perf import render_diff_lines
+
+        reports = forensics.get("reports") or []
+        captures = forensics.get("captures") or []
+        lines.append(
+            f"perf forensics: {len(reports)} regression report(s), "
+            f"{len(captures)} capture(s)")
+        for rep in reports:
+            head = (f"  [{rep.get('rule') or rep.get('reason')}] "
+                    f"rank {rep.get('rank')}")
+            cap = rep.get("capture") or {}
+            if cap.get("report"):
+                head += f" (capture: {cap['report']})"
+            lines.append(head)
+            diff = rep.get("diff")
+            if diff:
+                lines.extend(render_diff_lines(diff, indent="    "))
+            else:
+                lines.append(
+                    "    (no attributable windows to diff — see the "
+                    "capture artifacts)")
+        for c in captures:
+            line = (f"  capture rank {c.get('rank')} "
+                    f"[{c.get('rule') or c.get('reason')}] "
+                    f"({c['file']}): {c.get('steps_captured')} step(s)")
+            if isinstance(c.get("window_s"), (int, float)):
+                line += f" in {c['window_s']:.1f}s"
+            fr = c.get("fractions") or {}
+            parts = ", ".join(
+                f"{name.replace('_', ' ')} {fr[name] * 100:.1f}%"
+                for name in ("compute", "collective", "host_callback",
+                             "data_wait", "checkpoint")
+                if isinstance(fr.get(name), (int, float))
+                and fr[name] > 0.0005)
+            if parts:
+                line += f"; {parts}"
+            if c.get("trace_dir"):
+                line += f"; trace {c['trace_dir']}/"
+            lines.append(line)
+        if forensics.get("trace_dirs"):
+            lines.append("  xprof traces recovered: "
+                         + ", ".join(forensics["trace_dirs"]))
     comms = diag.get("comms")
     if comms:
         pred = comms.get("predicted_wire_bytes_per_device_per_step")

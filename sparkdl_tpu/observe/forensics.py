@@ -1,0 +1,264 @@
+"""Driver-side perf-forensics manager: alert-triggered (and manual)
+on-demand profiling with differential step attribution.
+
+The driver half of the forensics round trip
+(:mod:`sparkdl_tpu.observe.capture` is the worker half). The platform
+already tells an operator *that* a perf regression happened
+(``step_time_regression`` et al. in ``alerts.json``); this module
+makes the firing produce its own evidence, the way a hang produces
+stack dumps and an OOM produces ``oom_report.json``:
+
+- **why** — ``perf.diff_attribution`` between the alert rule's own
+  calibration window (the healthy past the baseline was computed
+  from, stashed by the alert engine) and the regressed window that
+  fired, written into the run dir as ``regression_report.json``
+  beside ``alerts.json``;
+- **what it looked like** — a ``MSG_PROFILE_REQ`` frame down the
+  offending rank's control socket (the ``MSG_DUMP_REQ`` pattern)
+  tells that rank's capture service to profile the next N steps:
+  xprof trace + uncapped attribution rows + device-memory snapshot,
+  recovered into the run dir at write time.
+
+Trigger paths sharing this machinery:
+
+- the alert-engine hook — ``launch_gang``'s monitor loop hands each
+  poll's firings to :meth:`ForensicsManager.on_alerts`, gated behind
+  ``SPARKDL_TPU_PROFILE_ON_ALERT`` (default off);
+- the manual ``POST /capturez?rank=N`` statusz endpoint (and the
+  ``python -m sparkdl_tpu.observe.capture URL`` CLI) via
+  :meth:`request_capture`;
+- the worker-side fixed-step knob ``SPARKDL_TPU_PROFILE_AT_STEP``
+  (A/B capture — no driver involvement at all).
+
+Flap control: a per-(rule, rank) cooldown
+(``SPARKDL_TPU_PROFILE_COOLDOWN_S``) on the alert path, plus at most
+one capture in flight per rank on every path (cleared when the
+worker's ``MSG_PROFILE_DONE`` lands) — a flapping alert can never
+stack profiler sessions on a struggling rank.
+
+Zero-overhead contract: :func:`maybe_make_forensics` returns None
+without live gang telemetry — no object, no knob read, no callback.
+The manager spans supervised attempts like the alert engine;
+:meth:`bind_server` rebinds it to each attempt's control plane.
+"""
+
+import threading
+import time
+
+from sparkdl_tpu.utils import knobs
+
+PROFILE_ON_ALERT_ENV = "SPARKDL_TPU_PROFILE_ON_ALERT"
+PROFILE_COOLDOWN_ENV = "SPARKDL_TPU_PROFILE_COOLDOWN_S"
+DEFAULT_COOLDOWN_S = 300.0
+
+# The alert rules whose firings are *perf* regressions — the ones a
+# profile window can explain. Liveness/memory rules have their own
+# forensic artifacts (stack dumps, oom/leak reports).
+PERF_RULES = ("step_time_regression", "mfu_drop", "overlap_drop")
+
+
+def maybe_make_forensics(telemetry, alert_engine=None, env=None):
+    """The latch: a :class:`ForensicsManager` only when gang telemetry
+    is live; None otherwise — no object, no knob read. The ON_ALERT
+    knob gates only the alert hook, not construction: the manual
+    ``/capturez`` path works on any telemetry-on gang."""
+    if telemetry is None:
+        return None
+    return ForensicsManager(telemetry, alert_engine=alert_engine,
+                            env=env)
+
+
+class ForensicsManager:
+    """Driver-side capture orchestration + regression-report builder.
+
+    Thread-safety: ``on_alerts`` runs on the monitor loop,
+    ``request_capture`` on statusz handler threads, and the
+    PROFILE_DONE callback on control-plane connection threads — one
+    lock covers the in-flight/cooldown/report state."""
+
+    def __init__(self, telemetry, alert_engine=None, env=None,
+                 clock=time.monotonic, wall=time.time):
+        self._telemetry = telemetry
+        self._engine = alert_engine
+        self._clock = clock
+        self._wall = wall
+        self.on_alert_enabled = knobs.read_bool(
+            PROFILE_ON_ALERT_ENV, env=env)
+        self.cooldown_s = float(
+            knobs.read(PROFILE_COOLDOWN_ENV, env=env)
+            or DEFAULT_COOLDOWN_S)
+        self._lock = threading.Lock()
+        self._server = None
+        self._inflight = {}    # rank -> trigger info
+        self._cooldowns = {}   # (rule, rank) -> monotonic ok-after
+        self._completed = []   # PROFILE_DONE metas, arrival order
+        self._entries = {}     # rank -> newest regression entry
+
+    # -- attempt wiring -----------------------------------------------
+
+    def bind_server(self, server):
+        """Rebind to this attempt's control plane: PROFILE_REQ frames
+        go out through it, and its PROFILE_DONE callback clears the
+        per-rank in-flight latch. An attempt's workers dying with a
+        capture outstanding also clears it (the dead rank can never
+        answer; the next attempt's rank N must be capturable)."""
+        with self._lock:
+            self._server = server
+            self._inflight.clear()
+        if server is not None:
+            server.on_profile_done = self._on_profile_done
+
+    # -- trigger paths ------------------------------------------------
+
+    def on_alerts(self, records):
+        """The monitor-loop hook: fired alert records from one
+        ``AlertEngine.poll`` pass. Perf-rule firings on a concrete
+        rank request a capture (cooldown + in-flight gated) and write
+        a regression entry; everything else is ignored. Inert unless
+        ``SPARKDL_TPU_PROFILE_ON_ALERT`` is set. Returns the (rule,
+        rank) pairs that started a capture."""
+        if not self.on_alert_enabled:
+            return []
+        started = []
+        for rec in records or ():
+            rule = rec.get("rule")
+            rank = rec.get("rank")
+            if rule not in PERF_RULES or not isinstance(rank, int):
+                continue
+            if self._trigger(rank, "alert", rule, alert=rec):
+                started.append((rule, rank))
+        return started
+
+    def request_capture(self, rank, reason="manual", rule=None):
+        """The manual path (``POST /capturez``): request a capture on
+        ``rank`` now. In-flight gated but cooldown-exempt — an
+        operator asking twice means it. Returns (ok, why)."""
+        try:
+            rank = int(rank)
+        except (TypeError, ValueError):
+            return False, "bad rank"
+        with self._lock:
+            if rank in self._inflight:
+                return False, f"capture already in flight on rank {rank}"
+            if self._server is None:
+                return False, "no control plane bound"
+        ok = self._trigger(rank, reason, rule)
+        return (ok, "requested" if ok
+                else f"rank {rank} has no control connection")
+
+    def _trigger(self, rank, reason, rule, alert=None):
+        now = self._clock()
+        with self._lock:
+            server = self._server
+            if server is None or rank in self._inflight:
+                return False
+            if alert is not None:
+                key = (rule, rank)
+                if now < self._cooldowns.get(key, 0.0):
+                    return False
+                self._cooldowns[key] = now + self.cooldown_s
+            self._inflight[rank] = {
+                "rank": rank, "reason": reason, "rule": rule,
+                "ts": self._wall(),
+            }
+        entry = self._build_entry(rank, reason, rule, alert)
+        if entry is not None:
+            with self._lock:
+                self._entries[rank] = entry
+            self._telemetry.add_regression_report(entry)
+        ok = server.request_profile(rank, reason=reason, rule=rule)
+        if not ok:
+            # No control connection for the rank (already dead, or a
+            # pre-READY attempt): release the latch so a later trigger
+            # can retry. The regression entry stays — the driver-side
+            # diff is evidence even without a worker capture.
+            with self._lock:
+                self._inflight.pop(rank, None)
+        return ok
+
+    # -- the differential report --------------------------------------
+
+    def _build_entry(self, rank, reason, rule, alert):
+        """One ``regression_report.json`` entry: the per-component
+        diff between the rank's calibration window and its current
+        (regressed) window, plus the trigger metadata. ``diff`` is
+        None when either window is unattributable (env/ledger
+        baselines carry no event window) — the entry still records
+        the firing and, later, the capture artifact names."""
+        from sparkdl_tpu.observe import perf
+
+        engine = self._engine
+        baseline = (engine.baseline_window(rank)
+                    if engine is not None else [])
+        window_s = engine.window_s if engine is not None else 60.0
+        regressed = (self._telemetry.recent_events(window_s)
+                     or {}).get(rank) or []
+        diff = None
+        if baseline and regressed:
+            try:
+                diff = perf.diff_attribution(baseline, regressed)
+            except Exception:
+                diff = None
+        if alert is None and diff is None:
+            # A manual capture with nothing to diff produces only the
+            # worker-side artifacts; no empty entry.
+            return None
+        return {
+            "rule": rule,
+            "rank": rank,
+            "reason": reason,
+            "ts": self._wall(),
+            "severity": (alert or {}).get("severity"),
+            "alert_detail": dict((alert or {}).get("detail") or {})
+            or None,
+            "diff": diff,
+            "capture": None,
+        }
+
+    # -- worker answers -----------------------------------------------
+
+    def _on_profile_done(self, rank, meta):
+        """PROFILE_DONE landed (control-plane connection thread):
+        clear the rank's in-flight latch, record the capture, and
+        attach its artifact names to the rank's regression entry."""
+        meta = dict(meta) if isinstance(meta, dict) else {}
+        with self._lock:
+            self._inflight.pop(rank, None)
+            info = {
+                "rank": rank,
+                "reason": meta.get("reason"),
+                "rule": meta.get("rule"),
+                "report": meta.get("report"),
+                "trace_dir": meta.get("trace_dir"),
+                "steps_captured": meta.get("steps_captured"),
+                "window_s": meta.get("window_s"),
+                "ts": self._wall(),
+            }
+            self._completed.append(info)
+            entry = self._entries.get(rank)
+            if entry is not None and entry.get("capture") is None:
+                entry["capture"] = {
+                    k: info[k] for k in
+                    ("report", "trace_dir", "steps_captured",
+                     "window_s")
+                }
+
+    # -- status surface (statusz `captures` block) --------------------
+
+    def captures_status(self):
+        """The statusz ``captures`` block: live in-flight and
+        completed captures plus the trigger config — what
+        ``observe.top`` renders."""
+        with self._lock:
+            return {
+                "on_alert": self.on_alert_enabled,
+                "cooldown_s": self.cooldown_s,
+                "in_flight": [dict(self._inflight[r])
+                              for r in sorted(self._inflight)],
+                "completed": [dict(c) for c in self._completed],
+            }
+
+
+__all__ = [
+    "ForensicsManager", "maybe_make_forensics", "PERF_RULES",
+]

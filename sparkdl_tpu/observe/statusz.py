@@ -33,6 +33,12 @@ today just wait for ``write()``):
     event as one ``data:`` line with its sequence as the SSE ``id``,
     so ``curl -N .../events`` watches the gang's step spans, health
     verdicts and chaos instants stream by in real time.
+``POST /capturez?rank=N``
+    Manual perf-forensics trigger (ISSUE 20): asks the forensics
+    manager to send a ``PROFILE_REQ`` frame down rank N's control
+    socket — the worker captures an xprof trace + uncapped
+    attribution window into its job dir. The ``captures`` block of
+    ``/statusz`` reports in-flight and completed captures.
 
 Zero-overhead contract (the PR 3 latch, extended): everything here is
 inert unless ``SPARKDL_TPU_STATUSZ_PORT`` is set — no thread, no
@@ -162,7 +168,8 @@ def statusz_port(env=None):
 
 
 def maybe_start_statusz(telemetry, detector=None, num_workers=None,
-                        alerts=None, elastic=None, env=None):
+                        alerts=None, elastic=None, forensics=None,
+                        env=None):
     """The latch: a running :class:`StatuszServer` when
     ``SPARKDL_TPU_STATUSZ_PORT`` is set and telemetry is live, None
     otherwise — no thread, no socket, no allocation on the default
@@ -175,7 +182,8 @@ def maybe_start_statusz(telemetry, detector=None, num_workers=None,
     try:
         return StatuszServer(
             telemetry, detector=detector, num_workers=num_workers,
-            alerts=alerts, elastic=elastic, port=port, env=env,
+            alerts=alerts, elastic=elastic, forensics=forensics,
+            port=port, env=env,
         ).start()
     except OSError as e:
         import logging
@@ -192,13 +200,14 @@ class StatuszServer:
     idempotent and joins the serve thread."""
 
     def __init__(self, telemetry, detector=None, num_workers=None,
-                 alerts=None, elastic=None, host="127.0.0.1", port=0,
-                 env=None):
+                 alerts=None, elastic=None, forensics=None,
+                 host="127.0.0.1", port=0, env=None):
         env = os.environ if env is None else env
         self._telemetry = telemetry
         self._detector = detector
         self._alerts = alerts
         self._elastic = elastic
+        self._forensics = forensics
         self.num_workers = num_workers
         self._t0 = time.time()
         self._closed = threading.Event()
@@ -223,6 +232,13 @@ class StatuszServer:
                     server._serve_events(self)
                 elif path == "/healthz":
                     server._send(self, 200, b"ok\n", "text/plain")
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path == "/capturez":
+                    server._serve_capturez(self, query)
                 else:
                     self.send_error(404)
 
@@ -312,6 +328,11 @@ class StatuszServer:
             }
         else:
             doc["alerts"] = {"enabled": False, "fired": []}
+        if self._forensics is not None:
+            try:
+                doc["captures"] = self._forensics.captures_status()
+            except Exception:
+                pass
         fleet = fleet_status()
         if fleet is not None:
             doc["fleet"] = fleet
@@ -393,6 +414,29 @@ class StatuszServer:
         except Exception:
             pass
         return {"window_s": self.window_s, "per_rank": per_rank}
+
+    def _serve_capturez(self, handler, query):
+        """``POST /capturez?rank=N`` — the one deliberate exception to
+        the handlers-only-read rule: the manual perf-forensics trigger
+        (``python -m sparkdl_tpu.observe.capture URL`` posts here).
+        The capture itself runs on the target worker; this only asks
+        the forensics manager to send the PROFILE_REQ frame. Omitting
+        ``rank`` targets rank 0."""
+        from urllib.parse import parse_qs
+
+        if self._forensics is None:
+            self._send(handler, 503,
+                       b'{"ok": false, "detail": '
+                       b'"perf forensics unavailable"}\n',
+                       "application/json")
+            return
+        rank = (parse_qs(query).get("rank") or ["0"])[0]
+        ok, why = self._forensics.request_capture(rank, reason="manual")
+        body = (json.dumps(
+            {"ok": ok, "detail": why, "rank": rank},
+            sort_keys=True) + "\n").encode()
+        self._send(handler, 200 if ok else 409, body,
+                   "application/json")
 
     def _serve_events(self, handler):
         """SSE tail of the live journal. Streams until the client

@@ -5,7 +5,8 @@ The operator-facing half of the ISSUE 14 live tier: point it at the
 statusz address the launcher logged (``statusz live at
 http://127.0.0.1:PORT/statusz``) and watch the gang run — per-rank
 step/progress/beat-age/HBM, the rolling attribution window, alert
-firings, and the fleet replica table when one is registered. Pure
+firings, in-flight/completed profile captures, and the fleet replica
+table when one is registered. Pure
 stdlib (urllib + ANSI clear), artifact-free, jax-free: it runs on a
 laptop against a port-forwarded driver.
 
@@ -112,6 +113,31 @@ def render(doc):
         lines.append(f"alerts: {len(fired)} fired")
         for a in fired:
             lines.append("  " + format_alert_line(a))
+
+    captures = doc.get("captures") or {}
+    inflight = captures.get("in_flight") or []
+    done = captures.get("completed") or []
+    if inflight or done:
+        lines.append("")
+        head = (f"profile captures: {len(inflight)} in flight, "
+                f"{len(done)} completed")
+        if captures.get("on_alert"):
+            head += (f" (on-alert armed, cooldown "
+                     f"{_fmt(captures.get('cooldown_s'), '{:.0f}s')})")
+        lines.append(head)
+        for c in inflight:
+            lines.append(
+                f"  rank {c.get('rank')} capturing "
+                f"[{c.get('rule') or c.get('reason')}] ...")
+        for c in done:
+            line = (f"  rank {c.get('rank')} "
+                    f"[{c.get('rule') or c.get('reason')}]: "
+                    f"{_fmt(c.get('steps_captured'), '{}')} step(s)")
+            if c.get("report"):
+                line += f" -> {c['report']}"
+            if c.get("trace_dir"):
+                line += f" + {c['trace_dir']}/"
+            lines.append(line)
 
     for fleet in doc.get("fleet") or []:
         lines.append("")

@@ -266,6 +266,50 @@ def device_memory_stats(device=None):
     return out or None
 
 
+class profiler_trace:
+    """Context manager capturing a JAX profiler trace of the enclosed
+    region into ``log_dir`` — or doing nothing at all, never raising.
+
+    ``__enter__`` returns the log dir when a trace actually started
+    and **None** otherwise (jax not yet imported in this process, a
+    jax line without ``jax.profiler``, another trace already active,
+    an unwritable dir). Same no-import rule as
+    :func:`device_memory_stats`: this runs inside the worker-side
+    forensic capture service (:mod:`sparkdl_tpu.observe.capture`), and
+    an evidence capture must never be the thing that initializes a
+    backend — a process that hasn't touched jax has nothing worth
+    profiling."""
+
+    def __init__(self, log_dir):
+        self._log_dir = log_dir
+        self._started = False
+        self._jax = None
+
+    def __enter__(self):
+        import os
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        try:
+            os.makedirs(self._log_dir, exist_ok=True)
+            jax.profiler.start_trace(self._log_dir)
+        except Exception:
+            return None
+        self._jax = jax
+        self._started = True
+        return self._log_dir
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._started:
+            try:
+                self._jax.profiler.stop_trace()
+            except Exception:
+                pass
+        return False
+
+
 def live_buffer_bytes():
     """Sum of live jax array bytes in this process — the fallback
     memory gauge where ``memory_stats`` is unimplemented (CPU rigs).

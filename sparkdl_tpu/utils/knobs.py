@@ -362,6 +362,28 @@ def _build():
           "legacy trace dir alias"),
         k("SPARKDL_TPU_PROFILE", "str", None, "observe",
           "utils.profiler opt-in (jax profiler traces)"),
+
+        # -- perf forensics (ISSUE 20) ------------------------------
+        k("SPARKDL_TPU_PROFILE_ON_ALERT", "bool", "0", "observe",
+          "perf-alert firings trigger an on-demand forensic capture "
+          "on the offending rank (xprof trace + uncapped attribution "
+          "window + regression_report.json diff)"),
+        k("SPARKDL_TPU_PROFILE_STEPS", "int", "20", "observe",
+          "train steps one forensic capture window spans (wall-clock "
+          "capped so a wedged step releases the profiler)"),
+        k("SPARKDL_TPU_PROFILE_COOLDOWN_S", "float", "300", "observe",
+          "per-(rule, rank) cooldown between alert-triggered "
+          "captures (flap guard; manual /capturez is exempt)"),
+        k("SPARKDL_TPU_PROFILE_AT_STEP", "int", None, "observe",
+          "worker-side fixed-step A/B trigger: capture one forensic "
+          "window when the rank reaches this train step"),
+        k("SPARKDL_TPU_BENCH_CAPTURE", "bool", "0", "observe",
+          "bench.py/serve_bench.py wrap the measured region (warm-up "
+          "excluded) in a profiler capture; set by their --capture "
+          "flags and forwarded to the measured child"),
+        k("SPARKDL_TPU_BENCH_CAPTURE_DIR", "path", None, "observe",
+          "where bench --capture writes its xprof trace (defaults "
+          "beside the bench JSON)"),
         k("SPARKDL_TPU_NATIVE_LOGS", "bool", None, "observe",
           "native control-plane log transport toggle"),
 

@@ -223,3 +223,82 @@ def test_handler_threads_are_pruned_and_drain_joins_outside_lock(
     t0 = time.monotonic()
     server.wait_drained(timeout=5.0)
     assert time.monotonic() - t0 < 5.0
+
+
+def test_profile_request_round_trip(server):
+    """Perf forensics (MSG_PROFILE_REQ/DONE): the driver asks a rank
+    to capture a profile window; the worker's framed watchdog
+    dispatches the request to the registered handler, and the DONE
+    answer lands in profile_reports plus the on_profile_done
+    callback — the MSG_DUMP_REQ pattern, for profiles."""
+    import threading
+    import time
+
+    done_cb = []
+    server.on_profile_done = (
+        lambda rank, meta: done_cb.append((rank, meta)))
+    got = threading.Event()
+    reqs = []
+
+    c1 = ControlPlaneClient(server.address, rank=1,
+                            secret=server.secret)
+    try:
+        def handler(req):
+            reqs.append(req)
+            got.set()
+            c1.send_profile_done({
+                "rank": 1, "reason": req.get("reason"),
+                "rule": req.get("rule"),
+                "report": "profile_report-rank-1-0.json",
+                "trace_dir": "xprof-rank-1-0",
+                "steps_captured": 3, "window_s": 0.5,
+            })
+
+        c1.set_profile_handler(handler)
+        c1.start_driver_watchdog()
+        c1.send_ready()
+        _drain(server)
+
+        assert server.request_profile(
+            1, reason="alert", rule="step_time_regression",
+            steps=3) is True
+        assert got.wait(10.0), "PROFILE_REQ never reached the handler"
+        assert reqs[0]["rule"] == "step_time_regression"
+        assert reqs[0]["reason"] == "alert"
+        assert reqs[0]["steps"] == 3
+        deadline = time.monotonic() + 10
+        while not server.profile_reports(1) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        (meta,) = server.profile_reports(1)
+        assert meta["report"] == "profile_report-rank-1-0.json"
+        assert meta["trace_dir"] == "xprof-rank-1-0"
+        assert meta["steps_captured"] == 3
+        assert done_cb and done_cb[0][0] == 1
+        assert done_cb[0][1]["window_s"] == 0.5
+        # an unconnected rank is a False, never an exception
+        assert server.request_profile(0) is False
+    finally:
+        c1.close()
+
+
+def test_profile_request_without_handler_is_dropped(server):
+    """A PROFILE_REQ to a worker with no capture service registered
+    (telemetry off) is silently dropped — the watchdog keeps
+    watching, the connection stays healthy."""
+    import time
+
+    c0 = ControlPlaneClient(server.address, rank=0,
+                            secret=server.secret)
+    try:
+        c0.start_driver_watchdog()
+        c0.send_ready()
+        _drain(server)
+        assert server.request_profile(0, reason="manual") is True
+        time.sleep(0.3)
+        assert server.profile_reports(0) == []
+        # the connection survived: a later frame still flows
+        c0.send_heartbeat({"progress": 1})
+        _drain(server)
+    finally:
+        c0.close()

@@ -1,0 +1,688 @@
+"""Perf forensics (ISSUE 20 tentpole): differential step attribution
+units, the driver-side trigger discipline (cooldown, single
+in-flight), the worker-side capture window, the zero-overhead latch
+extension, and — at the bottom, [gang+slow+chaos] — the real thing:
+an injected slowdown whose alert triggers a capture on the victim
+rank only."""
+
+import contextlib
+import glob
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu import observe
+from sparkdl_tpu.observe import capture as capture_mod
+from sparkdl_tpu.observe import forensics as forensics_mod
+from sparkdl_tpu.observe import perf
+from sparkdl_tpu.observe.capture import CaptureService
+from sparkdl_tpu.observe.forensics import (
+    ForensicsManager,
+    maybe_make_forensics,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_observe(monkeypatch):
+    monkeypatch.delenv(observe.TELEMETRY_DIR_ENV, raising=False)
+    monkeypatch.delenv(capture_mod.PROFILE_STEPS_ENV, raising=False)
+    monkeypatch.delenv(capture_mod.PROFILE_AT_STEP_ENV, raising=False)
+    monkeypatch.delenv(forensics_mod.PROFILE_ON_ALERT_ENV,
+                       raising=False)
+    monkeypatch.delenv(forensics_mod.PROFILE_COOLDOWN_ENV,
+                       raising=False)
+    observe._reset_for_tests()
+    yield
+    observe._reset_for_tests()
+
+
+US = 1000  # µs per ms
+
+
+def span(name, cat, ts_ms, dur_ms, tid, **args):
+    return {"name": name, "cat": cat, "ph": "X", "ts": ts_ms * US,
+            "dur": dur_ms * US, "tid": tid, "args": args}
+
+
+def _steps(n, step_ms, *, start_ms=0, gap_ms=5, sub=None):
+    """``n`` execute-phase step spans, each optionally carrying the
+    ``sub(step_index, step_start_ms)`` extra spans of the scenario."""
+    evs = []
+    t = start_ms
+    for i in range(n):
+        evs.append(span("train_step", "train", t, step_ms, tid=1,
+                        step=i, phase="execute"))
+        if sub is not None:
+            evs.extend(sub(i, t))
+        t += step_ms + gap_ms
+    return evs
+
+
+# -- diff_attribution units --------------------------------------------------
+
+
+def test_diff_pure_collective_growth_names_collective():
+    base = _steps(4, 100, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 10, tid=1)])
+    reg = _steps(4, 200, start_ms=10_000, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 110, tid=1)])
+    diff = perf.diff_attribution(base, reg)
+    assert diff is not None
+    assert diff["schema"] == perf.REGRESSION_SCHEMA
+    assert diff["significant"] is True
+    assert diff["top_growing_component"] == "collective"
+    assert diff["delta"]["step_s"] == pytest.approx(0.100)
+    assert diff["delta"]["step_factor"] == pytest.approx(2.0)
+    assert diff["delta"]["components_per_step"]["collective"] == \
+        pytest.approx(0.100)
+    # essentially all of the growth is the collective
+    assert diff["growth_fraction"]["collective"] == pytest.approx(
+        1.0, abs=1e-6)
+    # raw events on both sides: the grown span is NAMED
+    assert [s["name"] for s in diff["top_growing_spans"]] == ["reduce"]
+    assert diff["top_growing_spans"][0]["delta_s"] == pytest.approx(
+        0.100)
+
+
+def test_diff_data_starvation_names_data_wait():
+    base = _steps(4, 100, sub=lambda i, t: [
+        span("input.next", "data", t + 5, 5, tid=1)])
+    reg = _steps(4, 180, start_ms=10_000, sub=lambda i, t: [
+        span("input.next", "data", t + 5, 85, tid=1)])
+    diff = perf.diff_attribution(base, reg)
+    assert diff["significant"] is True
+    assert diff["top_growing_component"] == "data_wait"
+    assert diff["delta"]["components_per_step"]["data_wait"] == \
+        pytest.approx(0.080)
+    # compute did not grow — the step thread is starved, not busy
+    assert diff["delta"]["components_per_step"]["compute"] == \
+        pytest.approx(0.0, abs=1e-6)
+
+
+def test_diff_overlap_collapse_shows_efficiency_drop():
+    """Baseline: the collective runs on another thread, fully hidden
+    under compute. Regressed: the same collective serializes on the
+    step thread — step time grows by its duration and overlap
+    efficiency falls from 1.0 to 0.0."""
+    base = _steps(4, 100, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 40, tid=2)])
+    reg = _steps(4, 140, start_ms=10_000, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 40, tid=1)])
+    diff = perf.diff_attribution(base, reg)
+    assert diff["significant"] is True
+    assert diff["top_growing_component"] == "collective"
+    assert diff["baseline"]["overlap_efficiency"] == pytest.approx(1.0)
+    assert diff["regressed"]["overlap_efficiency"] == pytest.approx(0.0)
+    assert diff["delta"]["overlap_efficiency"] == pytest.approx(-1.0)
+
+
+def test_diff_zero_delta_stays_under_the_noise_floor():
+    base = _steps(5, 100, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 20, tid=1)])
+    reg = _steps(5, 100, start_ms=10_000, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 20, tid=1)])
+    diff = perf.diff_attribution(base, reg)
+    assert diff is not None
+    assert diff["significant"] is False
+    assert diff["top_growing_component"] is None
+    assert diff["growth_fraction"] == {}
+    assert diff["top_growing_spans"] == []
+    # the floor is the relative one: 5% of a 0.1s baseline step
+    assert diff["noise_floor_s"] == pytest.approx(0.005)
+
+
+def test_diff_capped_rows_fallback_has_no_span_names():
+    """Per-step attribution rows (what a 200-row-capped perf.json
+    retains) still diff — component culprit named, span names not."""
+    def rows(coll_s, dur_s):
+        return [{
+            "step": i, "dur_s": dur_s,
+            "components": {"compute": dur_s - coll_s,
+                           "collective": coll_s,
+                           "host_callback": 0.0, "data_wait": 0.0,
+                           "checkpoint": 0.0},
+            "overlapped_collective_s": 0.0,
+            "collective_total_s": coll_s,
+        } for i in range(4)]
+
+    diff = perf.diff_attribution(rows(0.01, 0.1), rows(0.11, 0.2))
+    assert diff["significant"] is True
+    assert diff["top_growing_component"] == "collective"
+    assert diff["top_growing_spans"] == []
+
+
+def test_diff_returns_none_when_a_side_is_unattributable():
+    reg = _steps(3, 100)
+    assert perf.diff_attribution([], reg) is None
+    assert perf.diff_attribution(reg, [{"name": "x"}]) is None
+    assert perf.diff_attribution(None, reg) is None
+
+
+def test_render_diff_lines_marks_the_culprit():
+    base = _steps(4, 100, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 10, tid=1)])
+    reg = _steps(4, 200, start_ms=10_000, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 110, tid=1)])
+    lines = perf.render_diff_lines(
+        perf.diff_attribution(base, reg), indent="  ")
+    text = "\n".join(lines)
+    assert "step time:" in text
+    assert "<-- grew the most" in text
+    assert "reduce" in text
+    assert all(line.startswith("  ") for line in lines)
+
+
+# -- ForensicsManager: trigger discipline ------------------------------------
+
+
+class _FakeServer:
+    def __init__(self, ok=True):
+        self.ok = ok
+        self.requests = []
+        self.on_profile_done = None
+
+    def request_profile(self, rank, reason="alert", rule=None,
+                        steps=None):
+        self.requests.append((rank, reason, rule))
+        return self.ok
+
+
+class _FakeTelemetry:
+    def __init__(self, events=None):
+        self.entries = []
+        self._events = events or {}
+
+    def add_regression_report(self, entry):
+        self.entries.append(entry)
+
+    def recent_events(self, window_s, now=None):
+        return {r: list(evs) for r, evs in self._events.items()}
+
+
+class _FakeEngine:
+    window_s = 60.0
+
+    def __init__(self, baselines=None):
+        self._baselines = baselines or {}
+
+    def baseline_window(self, rank):
+        return list(self._baselines.get(rank) or ())
+
+
+def _alert(rule="step_time_regression", rank=1, **detail):
+    return {"rule": rule, "rank": rank, "severity": "warning",
+            "detail": detail}
+
+
+def _manager(telemetry=None, engine=None, env=None, **kw):
+    env = dict(env or {})
+    env.setdefault(forensics_mod.PROFILE_ON_ALERT_ENV, "1")
+    return ForensicsManager(
+        telemetry if telemetry is not None else _FakeTelemetry(),
+        alert_engine=engine, env=env, **kw)
+
+
+def test_on_alerts_inert_without_the_knob():
+    telemetry = _FakeTelemetry()
+    mgr = ForensicsManager(telemetry, env={})
+    server = _FakeServer()
+    mgr.bind_server(server)
+    assert mgr.on_alert_enabled is False
+    assert mgr.on_alerts([_alert()]) == []
+    assert server.requests == []
+    assert telemetry.entries == []
+
+
+def test_alert_fires_capture_and_writes_regression_entry():
+    base = _steps(4, 100, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 10, tid=1)])
+    reg = _steps(4, 200, start_ms=10_000, sub=lambda i, t: [
+        span("reduce", "collective", t + 10, 110, tid=1)])
+    telemetry = _FakeTelemetry(events={1: reg})
+    mgr = _manager(telemetry, engine=_FakeEngine({1: base}))
+    server = _FakeServer()
+    mgr.bind_server(server)
+    started = mgr.on_alerts([_alert(median_step_s=0.2)])
+    assert started == [("step_time_regression", 1)]
+    assert server.requests == [(1, "alert", "step_time_regression")]
+    (entry,) = telemetry.entries
+    assert entry["rule"] == "step_time_regression"
+    assert entry["rank"] == 1
+    assert entry["alert_detail"] == {"median_step_s": 0.2}
+    assert entry["diff"]["top_growing_component"] == "collective"
+    assert entry["capture"] is None  # no DONE yet
+
+
+def test_non_perf_rules_and_rankless_alerts_are_ignored():
+    mgr = _manager()
+    server = _FakeServer()
+    mgr.bind_server(server)
+    assert mgr.on_alerts([
+        _alert(rule="heartbeat_gap"),          # liveness, not perf
+        _alert(rule="hbm_high_water"),         # memory, not perf
+        _alert(rule="mfu_drop", rank=None),    # no concrete rank
+        _alert(rule="mfu_drop", rank="driver"),
+    ]) == []
+    assert server.requests == []
+
+
+def test_cooldown_blocks_refire_until_elapsed():
+    t = {"now": 100.0}
+    mgr = _manager(env={forensics_mod.PROFILE_COOLDOWN_ENV: "50"},
+                   clock=lambda: t["now"])
+    server = _FakeServer()
+    mgr.bind_server(server)
+    assert mgr.cooldown_s == 50.0
+    assert mgr.on_alerts([_alert()]) == [("step_time_regression", 1)]
+    server.on_profile_done(1, {"report": "r.json"})  # capture landed
+    # same (rule, rank) inside the cooldown: dropped
+    t["now"] = 120.0
+    assert mgr.on_alerts([_alert()]) == []
+    # a DIFFERENT perf rule on the same rank has its own cooldown
+    assert mgr.on_alerts([_alert(rule="mfu_drop")]) == [
+        ("mfu_drop", 1)]
+    server.on_profile_done(1, {})
+    # past the cooldown the original rule fires again
+    t["now"] = 151.0
+    assert mgr.on_alerts([_alert()]) == [("step_time_regression", 1)]
+    assert [r[0] for r in server.requests] == [1, 1, 1]
+
+
+def test_single_capture_in_flight_per_rank():
+    mgr = _manager(env={forensics_mod.PROFILE_COOLDOWN_ENV: "0"})
+    server = _FakeServer()
+    mgr.bind_server(server)
+    assert mgr.on_alerts([_alert()]) == [("step_time_regression", 1)]
+    # no DONE yet: every further trigger on rank 1 is latched out,
+    # even a different rule, even the cooldown-exempt manual path
+    assert mgr.on_alerts([_alert(rule="mfu_drop")]) == []
+    ok, why = mgr.request_capture(1)
+    assert ok is False and "in flight" in why
+    # another rank is independent
+    assert mgr.on_alerts([_alert(rank=0)]) == [
+        ("step_time_regression", 0)]
+    status = mgr.captures_status()
+    assert [c["rank"] for c in status["in_flight"]] == [0, 1]
+    # the DONE frame releases rank 1
+    server.on_profile_done(1, {"report": "r.json", "trace_dir": "x",
+                               "steps_captured": 5, "window_s": 1.0})
+    ok, why = mgr.request_capture(1)
+    assert ok is True and why == "requested"
+    status = mgr.captures_status()
+    assert [c["rank"] for c in status["completed"]] == [1]
+    assert status["completed"][0]["report"] == "r.json"
+
+
+def test_manual_capture_is_cooldown_exempt():
+    t = {"now": 100.0}
+    mgr = _manager(env={forensics_mod.PROFILE_COOLDOWN_ENV: "1000"},
+                   clock=lambda: t["now"])
+    server = _FakeServer()
+    mgr.bind_server(server)
+    mgr.on_alerts([_alert()])
+    server.on_profile_done(1, {})
+    # deep inside the alert cooldown an operator asking means it
+    ok, why = mgr.request_capture(1, rule="step_time_regression")
+    assert ok is True
+    assert len(server.requests) == 2
+
+
+def test_failed_request_releases_the_latch_but_keeps_the_entry():
+    base = _steps(4, 100)
+    reg = _steps(4, 200, start_ms=10_000)
+    telemetry = _FakeTelemetry(events={1: reg})
+    mgr = _manager(telemetry, engine=_FakeEngine({1: base}),
+                   env={forensics_mod.PROFILE_COOLDOWN_ENV: "0"})
+    server = _FakeServer(ok=False)  # rank has no control connection
+    mgr.bind_server(server)
+    assert mgr.on_alerts([_alert()]) == []
+    # the driver-side diff is still evidence
+    assert len(telemetry.entries) == 1
+    assert mgr.captures_status()["in_flight"] == []
+    # and the rank is retryable
+    server.ok = True
+    assert mgr.on_alerts([_alert()]) == [("step_time_regression", 1)]
+
+
+def test_manual_capture_without_server_or_with_bad_rank():
+    mgr = _manager()
+    assert mgr.request_capture(1) == (False, "no control plane bound")
+    mgr.bind_server(_FakeServer())
+    assert mgr.request_capture("nope")[0] is False
+
+
+def test_bind_server_clears_stale_inflight_latches():
+    mgr = _manager(env={forensics_mod.PROFILE_COOLDOWN_ENV: "0"})
+    old = _FakeServer()
+    mgr.bind_server(old)
+    mgr.on_alerts([_alert()])
+    assert mgr.captures_status()["in_flight"] != []
+    # the attempt died with the capture outstanding; the next
+    # attempt's rank 1 must be capturable
+    new = _FakeServer()
+    mgr.bind_server(new)
+    assert new.on_profile_done == mgr._on_profile_done
+    assert mgr.captures_status()["in_flight"] == []
+    assert mgr.on_alerts([_alert()]) == [("step_time_regression", 1)]
+
+
+def test_profile_done_attaches_capture_to_the_entry():
+    base = _steps(4, 100)
+    reg = _steps(4, 200, start_ms=10_000)
+    telemetry = _FakeTelemetry(events={1: reg})
+    mgr = _manager(telemetry, engine=_FakeEngine({1: base}))
+    server = _FakeServer()
+    mgr.bind_server(server)
+    mgr.on_alerts([_alert()])
+    server.on_profile_done(1, {
+        "report": "profile_report-rank-1-0.json",
+        "trace_dir": "xprof-rank-1-0",
+        "steps_captured": 8, "window_s": 2.5,
+    })
+    (entry,) = telemetry.entries
+    assert entry["capture"] == {
+        "report": "profile_report-rank-1-0.json",
+        "trace_dir": "xprof-rank-1-0",
+        "steps_captured": 8, "window_s": 2.5,
+    }
+
+
+# -- CaptureService: the worker-side window ----------------------------------
+
+
+class _FakeClient:
+    def __init__(self):
+        self.handler = None
+        self.done = []
+        self.done_evt = threading.Event()
+
+    def set_profile_handler(self, handler):
+        self.handler = handler
+
+    def send_profile_done(self, meta):
+        self.done.append(meta)
+        self.done_evt.set()
+
+
+def _feed_steps(svc, n, start_ms=0, sub=None):
+    for ev in _steps(n, 50, start_ms=start_ms, sub=sub):
+        svc._tap(ev)
+
+
+def _no_profiler(monkeypatch):
+    """Swap the xprof shim for a no-op: the real profiler's start/stop
+    can take >10s on a loaded full-suite process, which is exactly the
+    lag the tap-closes-window design absorbs — but these unit tests
+    assert on window mechanics, not on jax. The real shim is covered
+    by test_aux_subsystems and ci/forensics_smoke.py."""
+
+    @contextlib.contextmanager
+    def _trace(path):
+        yield None
+
+    monkeypatch.setattr(capture_mod.jax_compat, "profiler_trace",
+                        _trace)
+
+
+def test_capture_window_writes_report_and_answers_done(
+        tmp_path, monkeypatch):
+    _no_profiler(monkeypatch)
+    client = _FakeClient()
+    svc = CaptureService(client, 1, str(tmp_path), steps=3,
+                         max_window_s=30.0, env={})
+    assert svc.trigger(reason="alert",
+                       rule="step_time_regression") is True
+    deadline = time.monotonic() + 5.0
+    while svc._buf is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert svc._buf is not None, "capture window never opened"
+    # each step's collective goes in BEFORE its step span: the third
+    # step span closes the window, so everything else must already be
+    # in the buffer
+    for i in range(3):
+        t = i * 55
+        svc._tap(span("reduce", "collective", t + 5, 10, tid=1))
+        svc._tap(span("train_step", "train", t, 50, tid=1, step=i,
+                      phase="execute"))
+    assert client.done_evt.wait(10.0), "DONE frame never sent"
+    # steps after the window closed are not part of the evidence
+    _feed_steps(svc, 2, start_ms=10_000)
+    (meta,) = client.done
+    assert meta["rank"] == 1
+    assert meta["rule"] == "step_time_regression"
+    assert meta["steps_captured"] == 3
+    path = os.path.join(str(tmp_path), meta["report"])
+    assert os.path.basename(path) == "profile_report-rank-1-0.json"
+    report = json.load(open(path))
+    assert report["schema"] == capture_mod.CAPTURE_SCHEMA
+    assert report["reason"] == "alert"
+    # UNCAPPED per-step rows with the collective attributed
+    att = report["attribution"]
+    assert att["steps"] == 3
+    assert len(att["per_step"]) == 3
+    assert att["components"]["collective"] == pytest.approx(0.030)
+    svc.stop()
+
+
+def test_trigger_is_single_in_flight(tmp_path):
+    svc = CaptureService(_FakeClient(), 0, str(tmp_path), steps=1,
+                         max_window_s=30.0, env={})
+    with svc._lock:
+        svc._capturing = True  # a window is already open
+    assert svc.trigger(reason="manual") is False
+    with svc._lock:
+        svc._capturing = False
+    svc.stop()
+
+
+def test_wall_clock_cap_bounds_a_stepless_window(
+        tmp_path, monkeypatch):
+    """A wedged step never advances the counter — the window must
+    still close (the hang detector owns the wedge itself)."""
+    _no_profiler(monkeypatch)
+    client = _FakeClient()
+    svc = CaptureService(client, 0, str(tmp_path), steps=100,
+                         max_window_s=0.2, env={})
+    assert svc.trigger(reason="manual") is True
+    assert client.done_evt.wait(10.0)
+    (meta,) = client.done
+    assert meta["steps_captured"] == 0
+    report = json.load(
+        open(os.path.join(str(tmp_path), meta["report"])))
+    assert report["attribution"]["steps"] == 0
+    svc.stop()
+
+
+def test_tap_chains_the_previous_observer(tmp_path):
+    mirrored = []
+    tl = observe.timeline()
+    prev, tl.observer = tl.observer, mirrored.append
+    try:
+        svc = CaptureService(_FakeClient(), 0, str(tmp_path),
+                             steps=1, env={}).start()
+        assert tl.observer == svc._tap
+        ev = span("train_step", "train", 0, 10, tid=1, step=0,
+                  phase="execute")
+        svc._tap(ev)
+        assert mirrored == [ev]  # the flight recorder still sees all
+        svc.stop()
+        assert tl.observer == mirrored.append  # chain restored
+    finally:
+        tl.observer = prev
+
+
+def test_at_step_knob_self_triggers_once(tmp_path):
+    svc = CaptureService(
+        _FakeClient(), 0, str(tmp_path), steps=1,
+        env={capture_mod.PROFILE_AT_STEP_ENV: "3"})
+    fired = []
+    svc.trigger = lambda **kw: fired.append(kw) or True
+    _feed_steps(svc, 10)
+    assert fired == [{"reason": "at_step"}]  # once, at step 3, only
+
+
+def test_profile_req_handler_spawns_a_capture(tmp_path, monkeypatch):
+    _no_profiler(monkeypatch)
+    client = _FakeClient()
+    svc = CaptureService(client, 2, str(tmp_path), steps=1,
+                         max_window_s=0.2, env={}).start()
+    assert client.handler is not None
+    client.handler({"reason": "alert", "rule": "mfu_drop",
+                    "steps": 1})
+    assert client.done_evt.wait(10.0)
+    assert client.done[0]["rule"] == "mfu_drop"
+    svc.stop()
+
+
+# -- the zero-overhead latch -------------------------------------------------
+
+
+def test_latch_no_telemetry_no_forensics_manager():
+    assert maybe_make_forensics(None) is None
+
+
+def test_latch_no_capture_service_when_telemetry_off(tmp_path):
+    tl_observer_before = observe.timeline().observer
+    threads_before = {t.name for t in threading.enumerate()}
+    assert capture_mod.maybe_start_capture_service(None, 0) is None
+    assert capture_mod.maybe_start_capture_service(
+        _FakeClient(), 0) is None  # observe disabled
+    assert observe.timeline().observer is tl_observer_before
+    assert {t.name for t in threading.enumerate()} == threads_before
+
+
+def test_latch_no_capture_service_without_job_dir(monkeypatch,
+                                                  tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    client = _FakeClient()
+    assert capture_mod.maybe_start_capture_service(
+        client, 0, env={}) is None
+    assert client.handler is None
+
+
+def test_latch_capture_service_starts_with_job_dir(monkeypatch,
+                                                   tmp_path):
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    observe._reset_for_tests()
+    client = _FakeClient()
+    svc = capture_mod.maybe_start_capture_service(
+        client, 3, env={"SPARKDL_TPU_JOB_DIR": str(tmp_path)})
+    assert svc is not None
+    assert client.handler is not None
+    assert observe.timeline().observer == svc._tap
+    svc.stop()
+
+
+# -- the real thing: injected slowdown → capture on the victim only ----------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _victim_rank_main(n_fast, n_slow, fast_s, slow_s):
+    """Rank 1 starts stalling on its input pipeline mid-run (a
+    cat="data" span the attribution can name); rank 0 keeps pace."""
+    import time as _time
+
+    from sparkdl_tpu import observe as _observe
+    import sparkdl_tpu.hvd as hvd
+    from sparkdl_tpu.parallel.train import instrument_step
+
+    hvd.init()
+    victim = hvd.rank() == 1
+
+    def step(i):
+        if victim and i >= n_fast:
+            with _observe.span("input.next", cat="data"):
+                _time.sleep(slow_s)
+        else:
+            _time.sleep(fast_s)
+        return i
+
+    stepped = instrument_step(step)
+    for i in range(n_fast + n_slow):
+        stepped(i)
+    return hvd.rank()
+
+
+@pytest.mark.gang
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_injected_slowdown_captures_the_victim_rank_only(
+        monkeypatch, tmp_path):
+    """Acceptance: a data-starved rank 1 trips step_time_regression,
+    the forensics hook captures rank 1 ONLY, regression_report.json
+    names the injected component, and the doctor renders it all from
+    the artifacts alone."""
+    from sparkdl import HorovodRunner
+    from sparkdl_tpu.observe import doctor
+
+    port = _free_port()
+    monkeypatch.setenv(observe.TELEMETRY_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("SPARKDL_TPU_TELEMETRY_FLUSH_S", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("SPARKDL_TPU_STATUSZ_PORT", str(port))
+    monkeypatch.setenv("SPARKDL_TPU_ALERTS", "1")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_CHECK_S", "0.1")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_MIN_STEPS", "3")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_WINDOW_S", "3")
+    monkeypatch.setenv("SPARKDL_TPU_ALERT_STEP_FACTOR", "2.0")
+    monkeypatch.setenv(forensics_mod.PROFILE_ON_ALERT_ENV, "1")
+    monkeypatch.setenv(capture_mod.PROFILE_STEPS_ENV, "3")
+    monkeypatch.setenv(forensics_mod.PROFILE_COOLDOWN_ENV, "600")
+    observe._reset_for_tests()
+
+    HorovodRunner(np=-2).run(
+        _victim_rank_main, n_fast=12, n_slow=20,
+        fast_s=0.05, slow_s=0.3)
+
+    (run_dir,) = glob.glob(str(tmp_path / "run-*"))
+
+    # the alert fired on the victim
+    alerts = json.load(open(os.path.join(run_dir, "alerts.json")))
+    fired = [a for a in alerts["alerts"]
+             if a["rule"] == "step_time_regression"]
+    assert fired and all(a["rank"] == 1 for a in fired)
+
+    # the capture landed on rank 1 ONLY
+    reports = glob.glob(os.path.join(run_dir, "profile_report-*.json"))
+    assert reports, "no capture artifact recovered into the run dir"
+    assert all("rank-1-" in os.path.basename(p) for p in reports)
+    report = json.load(open(sorted(reports)[0]))
+    assert report["schema"] == capture_mod.CAPTURE_SCHEMA
+    assert report["rule"] == "step_time_regression"
+    assert report["steps_captured"] >= 1
+    assert report["attribution"]["steps"] >= 1
+
+    # regression_report.json names the injected component
+    reg = json.load(
+        open(os.path.join(run_dir, "regression_report.json")))
+    assert reg["schema"] == perf.REGRESSION_SCHEMA
+    (entry,) = reg["reports"]
+    assert entry["rule"] == "step_time_regression"
+    assert entry["rank"] == 1
+    diff = entry["diff"]
+    assert diff is not None, "no differential attribution in the entry"
+    assert diff["significant"] is True
+    assert diff["top_growing_component"] == "data_wait"
+    assert any(s["name"] == "input.next"
+               for s in diff["top_growing_spans"])
+    assert entry["capture"] is not None
+    assert entry["capture"]["report"] in {
+        os.path.basename(p) for p in reports}
+
+    # the doctor renders the forensics section, artifact-only
+    text = doctor.render_text(doctor.diagnose(run_dir))
+    assert "perf forensics" in text
+    assert "data_wait" in text
+    assert "grew the most" in text
